@@ -1,0 +1,469 @@
+"""Per-feature value->bin mapping (bin boundary finding on sampled values).
+
+TPU-native counterpart of the reference ``BinMapper`` (include/LightGBM/bin.h:58-215,
+src/io/bin.cpp:80-530).  The host finds bin boundaries on a sample of the data exactly
+the way the reference does — greedy count-balanced boundaries with special handling of
+the zero region, missing values (None/Zero/NaN), and count-sorted categorical bins —
+then bulk value->bin conversion is vectorized NumPy (the binned matrix is what lives
+in TPU HBM, so this path runs once at dataset construction).
+
+Behavioral parity notes (same constants/semantics as the reference):
+- ``kZeroThreshold = 1e-35`` separates the zero region (meta.h:53);
+- adjacent sampled values within one ULP are merged, keeping the larger value
+  (common.h:894 ``CheckDoubleEqualOrdered``; bin.cpp:371-385);
+- bin upper bounds are midpoints nudged one ULP up (common.h:899);
+- with ``MissingType.NAN`` the last bin is reserved for NaN (bin.cpp:404-407);
+- categorical bins are count-sorted, never start with category 0, drop the <1% tail
+  (bin.cpp:427-497); unseen/negative categories map to the last bin (bin.h:524-539);
+- a feature is trivial if one bin, or if no boundary leaves >= min_split_data on both
+  sides (bin.cpp:55-77 ``NeedFilter``).
+"""
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+K_ZERO_THRESHOLD = 1e-35
+K_SPARSE_THRESHOLD = 0.7  # bin.h:36
+
+
+class MissingType(IntEnum):
+    NONE = 0
+    ZERO = 1
+    NAN = 2
+
+
+class BinType(IntEnum):
+    NUMERICAL = 0
+    CATEGORICAL = 1
+
+
+def _next_up(a):
+    return np.nextafter(a, np.inf)
+
+
+def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray, max_bin: int,
+                    total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Count-balanced boundary finding on one value range (bin.cpp:80-158)."""
+    assert max_bin > 0
+    n = len(distinct_values)
+    bounds: List[float] = []
+    if n == 0:
+        return [np.inf]
+    if n <= max_bin:
+        cur = 0
+        for i in range(n - 1):
+            cur += int(counts[i])
+            if cur >= min_data_in_bin:
+                val = _next_up((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                if not bounds or val > _next_up(bounds[-1]):
+                    bounds.append(float(val))
+                    cur = 0
+        bounds.append(np.inf)
+        return bounds
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, int(total_cnt // min_data_in_bin)))
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_sample_cnt = int(total_cnt - counts[is_big].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+
+    uppers: List[float] = []
+    lowers: List[float] = [float(distinct_values[0])]
+    cur = 0
+    for i in range(n - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur += int(counts[i])
+        if (is_big[i] or cur >= mean_bin_size
+                or (is_big[i + 1] and cur >= max(1.0, mean_bin_size * 0.5))):
+            uppers.append(float(distinct_values[i]))
+            lowers.append(float(distinct_values[i + 1]))
+            if len(uppers) >= max_bin - 1:
+                break
+            cur = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    for i in range(len(uppers)):
+        val = float(_next_up((uppers[i] + lowers[i + 1]) / 2.0))
+        if not bounds or val > _next_up(bounds[-1]):
+            bounds.append(val)
+    bounds.append(np.inf)
+    return bounds
+
+
+def _split_zero_region(distinct_values: np.ndarray, counts: np.ndarray):
+    neg = distinct_values <= -K_ZERO_THRESHOLD
+    pos = distinct_values > K_ZERO_THRESHOLD
+    zero = ~neg & ~pos
+    left_cnt = int(neg.sum())
+    right_start_idx = np.flatnonzero(pos)
+    right_start = int(right_start_idx[0]) if right_start_idx.size else -1
+    return (int(counts[neg].sum()), int(counts[zero].sum()), int(counts[pos].sum()),
+            left_cnt, right_start)
+
+
+def find_bin_with_zero_as_one_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                                  max_bin: int, total_sample_cnt: int,
+                                  min_data_in_bin: int) -> List[float]:
+    """Zero gets its own bin between negative and positive ranges (bin.cpp:261-316)."""
+    left_cnt_data, cnt_zero, right_cnt_data, left_cnt, right_start = \
+        _split_zero_region(distinct_values, counts)
+
+    bounds: List[float] = []
+    if left_cnt > 0 and max_bin > 1:
+        denom = max(total_sample_cnt - cnt_zero, 1)
+        left_max_bin = max(1, int(left_cnt_data / denom * (max_bin - 1)))
+        bounds = greedy_find_bin(distinct_values[:left_cnt], counts[:left_cnt],
+                                 left_max_bin, left_cnt_data, min_data_in_bin)
+        if bounds:
+            bounds[-1] = -K_ZERO_THRESHOLD
+    right_max_bin = max_bin - 1 - len(bounds)
+    if right_start >= 0 and right_max_bin > 0:
+        right_bounds = greedy_find_bin(distinct_values[right_start:],
+                                       counts[right_start:], right_max_bin,
+                                       right_cnt_data, min_data_in_bin)
+        bounds.append(K_ZERO_THRESHOLD)
+        bounds.extend(right_bounds)
+    else:
+        bounds.append(np.inf)
+    assert len(bounds) <= max_bin
+    return bounds
+
+
+def find_bin_with_predefined_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                                 max_bin: int, total_sample_cnt: int,
+                                 min_data_in_bin: int,
+                                 forced_upper_bounds: Sequence[float]) -> List[float]:
+    """Forced bounds first, remaining budget distributed by count (bin.cpp:158-258)."""
+    _, _, _, left_cnt, right_start = _split_zero_region(distinct_values, counts)
+
+    bounds: List[float] = []
+    if max_bin == 2:
+        bounds.append(K_ZERO_THRESHOLD if left_cnt == 0 else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            bounds.append(-K_ZERO_THRESHOLD)
+        if right_start >= 0:
+            bounds.append(K_ZERO_THRESHOLD)
+    bounds.append(np.inf)
+    max_to_insert = max_bin - len(bounds)
+    inserted = 0
+    for fb in forced_upper_bounds:
+        if inserted >= max_to_insert:
+            break
+        if abs(fb) > K_ZERO_THRESHOLD:
+            bounds.append(float(fb))
+            inserted += 1
+    bounds.sort()
+
+    free_bins = max_bin - len(bounds)
+    to_add: List[float] = []
+    value_ind = 0
+    n = len(distinct_values)
+    for i, ub in enumerate(bounds):
+        bin_start = value_ind
+        cnt_in_bin = 0
+        while value_ind < n and distinct_values[value_ind] < ub:
+            cnt_in_bin += int(counts[value_ind])
+            value_ind += 1
+        bins_remaining = max_bin - len(bounds) - len(to_add)
+        num_sub_bins = int(round(cnt_in_bin * free_bins / max(total_sample_cnt, 1)))
+        num_sub_bins = min(num_sub_bins, bins_remaining) + 1
+        if i == len(bounds) - 1:
+            num_sub_bins = bins_remaining + 1
+        sub = greedy_find_bin(distinct_values[bin_start:value_ind],
+                              counts[bin_start:value_ind], num_sub_bins,
+                              cnt_in_bin, min_data_in_bin)
+        to_add.extend(sub[:-1])  # last bound is infinity
+    bounds.extend(to_add)
+    bounds.sort()
+    assert len(bounds) <= max_bin
+    return bounds
+
+
+def _distinct_with_zeros(values: np.ndarray, zero_cnt: int):
+    """Sorted distinct (value, count) lists with the zero region inserted
+    (bin.cpp:352-396): values within one ULP merge to the larger value."""
+    values = np.sort(values.astype(np.float64))
+    n = len(values)
+    if n == 0:
+        return np.array([0.0]), np.array([zero_cnt], dtype=np.int64)
+    new_group = np.empty(n, dtype=bool)
+    new_group[0] = True
+    new_group[1:] = values[1:] > _next_up(values[:-1])
+    starts = np.flatnonzero(new_group)
+    group_counts = np.diff(np.append(starts, n))
+    # representative is the largest member of each ULP-merged group
+    ends = np.append(starts[1:], n) - 1
+    reps = values[ends]
+
+    distinct: List[float] = []
+    counts: List[int] = []
+    if reps[0] > 0.0 and zero_cnt > 0:
+        distinct.append(0.0)
+        counts.append(zero_cnt)
+    for i in range(len(reps)):
+        if i > 0 and reps[i - 1] < 0.0 and reps[i] > 0.0:
+            distinct.append(0.0)
+            counts.append(zero_cnt)
+        distinct.append(float(reps[i]))
+        counts.append(int(group_counts[i]))
+    if reps[-1] < 0.0 and zero_cnt > 0:
+        distinct.append(0.0)
+        counts.append(zero_cnt)
+    return np.asarray(distinct), np.asarray(counts, dtype=np.int64)
+
+
+class BinMapper:
+    """Value->bin mapping for one feature (bin.h:58-215)."""
+
+    def __init__(self) -> None:
+        self.num_bin: int = 1
+        self.is_trivial: bool = True
+        self.bin_type: BinType = BinType.NUMERICAL
+        self.missing_type: MissingType = MissingType.NONE
+        self.bin_upper_bound: np.ndarray = np.array([np.inf])
+        self.bin_2_categorical: List[int] = []
+        self.categorical_2_bin: Dict[int, int] = {}
+        self.min_val: float = 0.0
+        self.max_val: float = 0.0
+        self.default_bin: int = 0
+        self.most_freq_bin: int = 0
+        self.sparse_rate: float = 1.0
+
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
+                 min_data_in_bin: int = 3, min_split_data: int = 0,
+                 bin_type: BinType = BinType.NUMERICAL, use_missing: bool = True,
+                 zero_as_missing: bool = False,
+                 forced_upper_bounds: Optional[Sequence[float]] = None) -> None:
+        """Find boundaries from (possibly zero-elided) sampled values (bin.cpp:329-530).
+
+        ``values`` are the sampled non-trivial entries; ``total_sample_cnt`` minus the
+        non-NaN sample count is the implied zero count (sparse sampling contract).
+        """
+        forced_upper_bounds = list(forced_upper_bounds or [])
+        values = np.asarray(values, dtype=np.float64)
+        nan_mask = np.isnan(values)
+        na_cnt = int(nan_mask.sum())
+        values = values[~nan_mask]
+        if not use_missing:
+            self.missing_type = MissingType.NONE
+        elif zero_as_missing:
+            self.missing_type = MissingType.ZERO
+        else:
+            self.missing_type = MissingType.NONE if na_cnt == 0 else MissingType.NAN
+        if not use_missing:
+            na_cnt = 0
+        self.bin_type = bin_type
+        self.default_bin = 0
+        zero_cnt = int(total_sample_cnt - len(values) - na_cnt)
+        distinct_values, counts = _distinct_with_zeros(values, zero_cnt)
+        self.min_val = float(distinct_values[0])
+        self.max_val = float(distinct_values[-1])
+        num_distinct = len(distinct_values)
+
+        cnt_in_bin: np.ndarray
+        if bin_type == BinType.NUMERICAL:
+            if self.missing_type == MissingType.ZERO:
+                bounds = self._find_bounds(distinct_values, counts, max_bin,
+                                           total_sample_cnt, min_data_in_bin,
+                                           forced_upper_bounds)
+                if len(bounds) == 2:
+                    self.missing_type = MissingType.NONE
+            elif self.missing_type == MissingType.NONE:
+                bounds = self._find_bounds(distinct_values, counts, max_bin,
+                                           total_sample_cnt, min_data_in_bin,
+                                           forced_upper_bounds)
+            else:
+                bounds = self._find_bounds(distinct_values, counts, max_bin - 1,
+                                           total_sample_cnt - na_cnt, min_data_in_bin,
+                                           forced_upper_bounds)
+                bounds = bounds + [np.nan]
+            self.bin_upper_bound = np.asarray(bounds)
+            self.num_bin = len(bounds)
+            data_bins = self.num_bin - (1 if self.missing_type == MissingType.NAN else 0)
+            idx = np.searchsorted(self.bin_upper_bound[:data_bins], distinct_values,
+                                  side="left")
+            cnt_in_bin = np.bincount(np.minimum(idx, data_bins - 1), weights=counts,
+                                     minlength=self.num_bin).astype(np.int64)
+            if self.missing_type == MissingType.NAN:
+                cnt_in_bin[self.num_bin - 1] = na_cnt
+            assert self.num_bin <= max_bin
+        else:
+            cnt_in_bin = self._find_categorical(distinct_values, counts,
+                                                total_sample_cnt, na_cnt, max_bin,
+                                                min_data_in_bin)
+
+        self.is_trivial = self.num_bin <= 1
+        if not self.is_trivial and self._need_filter(cnt_in_bin, total_sample_cnt,
+                                                     min_split_data):
+            self.is_trivial = True
+        if not self.is_trivial:
+            self.default_bin = int(self.value_to_bin(0.0))
+            if bin_type == BinType.CATEGORICAL:
+                assert self.default_bin > 0
+            self.most_freq_bin = int(np.argmax(cnt_in_bin))
+            self.sparse_rate = float(cnt_in_bin[self.default_bin]) / max(total_sample_cnt, 1)
+            max_rate = float(cnt_in_bin[self.most_freq_bin]) / max(total_sample_cnt, 1)
+            if self.most_freq_bin != self.default_bin and max_rate > K_SPARSE_THRESHOLD:
+                self.sparse_rate = max_rate
+            else:
+                self.most_freq_bin = self.default_bin
+        else:
+            self.sparse_rate = 1.0
+
+    @staticmethod
+    def _find_bounds(distinct_values, counts, max_bin, total_sample_cnt,
+                     min_data_in_bin, forced_upper_bounds):
+        if forced_upper_bounds:
+            return find_bin_with_predefined_bin(distinct_values, counts, max_bin,
+                                                total_sample_cnt, min_data_in_bin,
+                                                forced_upper_bounds)
+        return find_bin_with_zero_as_one_bin(distinct_values, counts, max_bin,
+                                             total_sample_cnt, min_data_in_bin)
+
+    def _find_categorical(self, distinct_values, counts, total_sample_cnt, na_cnt,
+                          max_bin, min_data_in_bin) -> np.ndarray:
+        """Count-sorted categorical bins (bin.cpp:427-497)."""
+        from ..utils.log import Log
+        vals_int: List[int] = []
+        cnts_int: List[int] = []
+        for v, c in zip(distinct_values, counts):
+            iv = int(v)
+            if iv < 0:
+                na_cnt += int(c)
+                Log.warning("Met negative value in categorical features, "
+                            "will convert it to NaN")
+            elif vals_int and iv == vals_int[-1]:
+                cnts_int[-1] += int(c)
+            else:
+                vals_int.append(iv)
+                cnts_int.append(int(c))
+        self.num_bin = 0
+        cnt_in_bin: List[int] = []
+        rest_cnt = total_sample_cnt - na_cnt
+        if rest_cnt > 0:
+            if vals_int and vals_int[-1] // 100 > len(vals_int):
+                Log.warning("Met categorical feature which contains sparse values. "
+                            "Consider renumbering to consecutive integers "
+                            "started from zero")
+            order = sorted(range(len(vals_int)), key=lambda i: -cnts_int[i])
+            vals_int = [vals_int[i] for i in order]
+            cnts_int = [cnts_int[i] for i in order]
+            if vals_int and vals_int[0] == 0:
+                if len(vals_int) == 1:
+                    vals_int.append(vals_int[0] + 1)
+                    cnts_int.append(0)
+                vals_int[0], vals_int[1] = vals_int[1], vals_int[0]
+                cnts_int[0], cnts_int[1] = cnts_int[1], cnts_int[0]
+            cut_cnt = int((total_sample_cnt - na_cnt) * 0.99)
+            used_cnt = 0
+            eff_max_bin = min(len(vals_int), max_bin)
+            self.categorical_2_bin = {}
+            self.bin_2_categorical = []
+            cur = 0
+            while cur < len(vals_int) and (used_cnt < cut_cnt or self.num_bin < eff_max_bin):
+                if cnts_int[cur] < min_data_in_bin and cur > 1:
+                    break
+                self.bin_2_categorical.append(vals_int[cur])
+                self.categorical_2_bin[vals_int[cur]] = self.num_bin
+                used_cnt += cnts_int[cur]
+                cnt_in_bin.append(cnts_int[cur])
+                self.num_bin += 1
+                cur += 1
+            if cur == len(vals_int) and na_cnt > 0:
+                self.bin_2_categorical.append(-1)
+                self.categorical_2_bin[-1] = self.num_bin
+                cnt_in_bin.append(0)
+                self.num_bin += 1
+            self.missing_type = (MissingType.NONE if cur == len(vals_int) and na_cnt == 0
+                                 else MissingType.NAN)
+            if cnt_in_bin:
+                cnt_in_bin[-1] += total_sample_cnt - used_cnt
+        return np.asarray(cnt_in_bin, dtype=np.int64)
+
+    def _need_filter(self, cnt_in_bin: np.ndarray, total_cnt: int,
+                     filter_cnt: int) -> bool:
+        if self.bin_type == BinType.NUMERICAL:
+            left = np.cumsum(cnt_in_bin[:-1])
+            ok = (left >= filter_cnt) & (total_cnt - left >= filter_cnt)
+            return not bool(ok.any())
+        if len(cnt_in_bin) <= 2:
+            for c in cnt_in_bin[:-1]:
+                if c >= filter_cnt and total_cnt - c >= filter_cnt:
+                    return False
+            return True
+        return False
+
+    # ---- conversion ----
+
+    def value_to_bin(self, value: float) -> int:
+        return int(self.values_to_bins(np.asarray([value]))[0])
+
+    def values_to_bins(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized ValueToBin (bin.h:503-539)."""
+        values = np.asarray(values, dtype=np.float64)
+        nan_mask = np.isnan(values)
+        if self.bin_type == BinType.NUMERICAL:
+            data_bins = self.num_bin - (1 if self.missing_type == MissingType.NAN else 0)
+            filled = np.where(nan_mask, 0.0, values)
+            out = np.searchsorted(self.bin_upper_bound[:data_bins], filled, side="left")
+            out = np.minimum(out, data_bins - 1)
+            if self.missing_type == MissingType.NAN:
+                out = np.where(nan_mask, self.num_bin - 1, out)
+            return out.astype(np.int32)
+        ints = np.where(nan_mask, -1, np.where(np.isfinite(values), values, -1)).astype(np.int64)
+        lut_size = max(self.bin_2_categorical + [0]) + 2
+        lut = np.full(lut_size, self.num_bin - 1, dtype=np.int32)
+        for cat, b in self.categorical_2_bin.items():
+            if cat >= 0:
+                lut[cat] = b
+        out = np.where((ints < 0) | (ints >= lut_size), self.num_bin - 1,
+                       lut[np.clip(ints, 0, lut_size - 1)])
+        return out.astype(np.int32)
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Representative value for a bin (used for model thresholds / plotting)."""
+        if self.bin_type == BinType.CATEGORICAL:
+            return float(self.bin_2_categorical[bin_idx])
+        return float(self.bin_upper_bound[bin_idx])
+
+    # ---- serialization (binary dataset file / distributed bin-finding sync) ----
+
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "missing_type": int(self.missing_type),
+            "is_trivial": self.is_trivial,
+            "sparse_rate": self.sparse_rate,
+            "bin_type": int(self.bin_type),
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+            "default_bin": self.default_bin,
+            "most_freq_bin": self.most_freq_bin,
+            "bin_upper_bound": [float(b) for b in self.bin_upper_bound]
+                               if self.bin_type == BinType.NUMERICAL else [],
+            "bin_2_categorical": list(self.bin_2_categorical),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = int(d["num_bin"])
+        m.missing_type = MissingType(d["missing_type"])
+        m.is_trivial = bool(d["is_trivial"])
+        m.sparse_rate = float(d["sparse_rate"])
+        m.bin_type = BinType(d["bin_type"])
+        m.min_val = float(d["min_val"])
+        m.max_val = float(d["max_val"])
+        m.default_bin = int(d["default_bin"])
+        m.most_freq_bin = int(d["most_freq_bin"])
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = [int(c) for c in d["bin_2_categorical"]]
+        m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        return m

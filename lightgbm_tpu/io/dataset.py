@@ -1,0 +1,263 @@
+"""Binned feature matrix resident in device (TPU HBM) memory.
+
+Counterpart of the reference ``Dataset`` (include/LightGBM/dataset.h:330-713,
+src/io/dataset.cpp) and the in-memory construction path
+``DatasetLoader::CostructFromSampleData`` (src/io/dataset_loader.cpp:572):
+sample rows -> per-feature ``BinMapper.find_bin`` -> bulk binning -> one
+``[num_data, num_used_features]`` integer matrix.
+
+TPU-first departures from the reference layout:
+- No per-feature polymorphic ``Bin`` storage (dense/sparse/4-bit): the learner
+  consumes one dense row-major matrix, the layout XLA/Pallas histogram kernels want.
+  Sparsity is exploited by bin width (uint8 for <=256 bins) rather than by format.
+- Feature bundling (EFB, dataset.cpp:92-290) is represented as a host-side mapping
+  so the device matrix has one column per *group*; round 1 keeps group == feature.
+- Trivial features (single bin) are dropped from the device matrix and re-inserted
+  at prediction time by index mapping, like the reference's used-feature mapping.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .binning import BinMapper, BinType, MissingType
+from .metadata import Metadata
+from ..utils.log import Log
+
+
+class BinnedDataset:
+    """Host handle for the binned matrix + metadata; device transfer is lazy."""
+
+    def __init__(self) -> None:
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.bin_mappers: List[BinMapper] = []
+        self.used_feature_idx: List[int] = []   # original index per used column
+        self.inner_feature_map: Dict[int, int] = {}  # original -> used column
+        self.binned: Optional[np.ndarray] = None     # [num_data, num_used] uint8/16
+        self.num_bin_per_feature: List[int] = []     # per used column
+        self.metadata: Metadata = Metadata(0)
+        self.feature_names: List[str] = []
+        self.raw_data: Optional[np.ndarray] = None   # kept for prediction paths
+        self._device_cache = None
+
+    # ---- construction ----
+
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, label=None, weight=None, group=None,
+                    init_score=None, max_bin: int = 255, min_data_in_bin: int = 3,
+                    min_data_in_leaf: int = 20, bin_construct_sample_cnt: int = 200000,
+                    categorical_feature: Sequence[int] = (), use_missing: bool = True,
+                    zero_as_missing: bool = False, data_random_seed: int = 1,
+                    feature_names: Optional[Sequence[str]] = None,
+                    forced_bins: Optional[Dict[int, List[float]]] = None,
+                    max_bin_by_feature: Optional[Sequence[int]] = None,
+                    reference: Optional["BinnedDataset"] = None,
+                    keep_raw: bool = True) -> "BinnedDataset":
+        data = np.ascontiguousarray(data, dtype=np.float64)
+        if data.ndim != 2:
+            Log.fatal("Input data must be 2-dimensional")
+        self = cls()
+        self.num_data, self.num_total_features = data.shape
+        self.metadata = Metadata(self.num_data)
+        if label is not None:
+            self.metadata.set_label(label)
+        if weight is not None:
+            self.metadata.set_weights(weight)
+        if group is not None:
+            self.metadata.set_group(group)
+        if init_score is not None:
+            self.metadata.set_init_score(init_score)
+        self.feature_names = (list(feature_names) if feature_names is not None
+                              else ["Column_%d" % i for i in range(self.num_total_features)])
+
+        if reference is not None:
+            # validation data reuses the training bin mappers
+            # (dataset_loader.cpp:230 LoadFromFileAlignWithOtherDataset)
+            if reference.num_total_features != self.num_total_features:
+                Log.fatal("Validation data has %d features, train data has %d",
+                          self.num_total_features, reference.num_total_features)
+            self.bin_mappers = reference.bin_mappers
+            self.feature_names = reference.feature_names
+        else:
+            self._find_bin_mappers(data, max_bin, min_data_in_bin, min_data_in_leaf,
+                                   bin_construct_sample_cnt, categorical_feature,
+                                   use_missing, zero_as_missing, data_random_seed,
+                                   forced_bins, max_bin_by_feature)
+
+        self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
+                                 if not m.is_trivial]
+        self.inner_feature_map = {f: j for j, f in enumerate(self.used_feature_idx)}
+        self.num_bin_per_feature = [self.bin_mappers[i].num_bin
+                                    for i in self.used_feature_idx]
+        max_nb = max(self.num_bin_per_feature, default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        cols = [self.bin_mappers[i].values_to_bins(data[:, i]).astype(dtype)
+                for i in self.used_feature_idx]
+        self.binned = (np.stack(cols, axis=1) if cols
+                       else np.zeros((self.num_data, 0), dtype=dtype))
+        if keep_raw:
+            self.raw_data = data
+        return self
+
+    def _find_bin_mappers(self, data, max_bin, min_data_in_bin, min_data_in_leaf,
+                          sample_cnt, categorical_feature, use_missing,
+                          zero_as_missing, seed, forced_bins, max_bin_by_feature):
+        rng = np.random.RandomState(seed)
+        n = self.num_data
+        if n > sample_cnt:
+            sample_idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
+        else:
+            sample_idx = np.arange(n)
+        total = len(sample_idx)
+        cat = set(int(c) for c in categorical_feature)
+        self.bin_mappers = []
+        for f in range(self.num_total_features):
+            col = data[sample_idx, f]
+            # sparse sampling contract: pass non-zero (plus NaN) values only,
+            # zeros are implied by total_sample_cnt (dataset_loader.cpp:819)
+            nz = col[(col != 0.0) | np.isnan(col)]
+            m = BinMapper()
+            fmax = (int(max_bin_by_feature[f]) if max_bin_by_feature
+                    else int(max_bin))
+            m.find_bin(nz, total, fmax, min_data_in_bin,
+                       min_split_data=min_data_in_leaf,
+                       bin_type=BinType.CATEGORICAL if f in cat else BinType.NUMERICAL,
+                       use_missing=use_missing, zero_as_missing=zero_as_missing,
+                       forced_upper_bounds=(forced_bins or {}).get(f))
+            if m.is_trivial:
+                Log.debug("Feature %s is trivial (constant or filtered)",
+                          self.feature_names[f] if self.feature_names else str(f))
+            self.bin_mappers.append(m)
+
+    # ---- device view ----
+
+    def device_view(self):
+        """Return (bins_device [N, F_used] int8/int16, num_bin array, metadata arrays).
+
+        Cached; the binned matrix is the only large array shipped to HBM.
+        """
+        if self._device_cache is None:
+            import jax.numpy as jnp
+            self._device_cache = jnp.asarray(self.binned)
+        return self._device_cache
+
+    @property
+    def num_features(self) -> int:
+        return len(self.used_feature_idx)
+
+    @property
+    def num_total_bin(self) -> int:
+        return int(sum(self.num_bin_per_feature))
+
+    @property
+    def max_num_bin(self) -> int:
+        return max(self.num_bin_per_feature, default=2)
+
+    def most_freq_bins(self) -> np.ndarray:
+        return np.asarray([self.bin_mappers[i].most_freq_bin
+                           for i in self.used_feature_idx], dtype=np.int32)
+
+    def feature_is_categorical(self) -> np.ndarray:
+        return np.asarray([self.bin_mappers[i].bin_type == BinType.CATEGORICAL
+                           for i in self.used_feature_idx], dtype=bool)
+
+    def missing_types(self) -> np.ndarray:
+        return np.asarray([int(self.bin_mappers[i].missing_type)
+                           for i in self.used_feature_idx], dtype=np.int32)
+
+    def default_bins(self) -> np.ndarray:
+        return np.asarray([self.bin_mappers[i].default_bin
+                           for i in self.used_feature_idx], dtype=np.int32)
+
+    # ---- serialization: binary dataset file (dataset.h:473 SaveBinaryFile) ----
+
+    MAGIC = b"LGBMTPU1"
+
+    def save_binary(self, path: str) -> None:
+        header = {
+            "num_data": self.num_data,
+            "num_total_features": self.num_total_features,
+            "feature_names": self.feature_names,
+            "bin_mappers": [m.to_dict() for m in self.bin_mappers],
+            "has_weights": self.metadata.weights is not None,
+            "has_group": self.metadata.query_boundaries is not None,
+            "has_init_score": self.metadata.init_score is not None,
+            "binned_dtype": str(self.binned.dtype),
+        }
+        with open(path, "wb") as fh:
+            fh.write(self.MAGIC)
+            hdr = json.dumps(header).encode()
+            fh.write(len(hdr).to_bytes(8, "little"))
+            fh.write(hdr)
+            np.save(fh, self.binned, allow_pickle=False)
+            np.save(fh, self.metadata.label, allow_pickle=False)
+            if self.metadata.weights is not None:
+                np.save(fh, self.metadata.weights, allow_pickle=False)
+            if self.metadata.query_boundaries is not None:
+                np.save(fh, self.metadata.query_boundaries, allow_pickle=False)
+            if self.metadata.init_score is not None:
+                np.save(fh, self.metadata.init_score, allow_pickle=False)
+        Log.info("Saved binary dataset to %s", path)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "BinnedDataset":
+        with open(path, "rb") as fh:
+            magic = fh.read(8)
+            if magic != cls.MAGIC:
+                Log.fatal("File %s is not a LightGBM-TPU binary dataset", path)
+            hdr_len = int.from_bytes(fh.read(8), "little")
+            header = json.loads(fh.read(hdr_len).decode())
+            self = cls()
+            self.num_data = header["num_data"]
+            self.num_total_features = header["num_total_features"]
+            self.feature_names = header["feature_names"]
+            self.bin_mappers = [BinMapper.from_dict(d) for d in header["bin_mappers"]]
+            self.binned = np.load(fh, allow_pickle=False)
+            self.metadata = Metadata(self.num_data)
+            self.metadata.label = np.load(fh, allow_pickle=False)
+            if header["has_weights"]:
+                self.metadata.weights = np.load(fh, allow_pickle=False)
+            if header["has_group"]:
+                self.metadata.query_boundaries = np.load(fh, allow_pickle=False)
+            if header["has_init_score"]:
+                self.metadata.init_score = np.load(fh, allow_pickle=False)
+        self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
+                                 if not m.is_trivial]
+        self.inner_feature_map = {f: j for j, f in enumerate(self.used_feature_idx)}
+        self.num_bin_per_feature = [self.bin_mappers[i].num_bin
+                                    for i in self.used_feature_idx]
+        self.metadata._update_query_weights()
+        return self
+
+    # ---- subsetting (dataset.h CopySubset / bagging-with-subset) ----
+
+    def subset(self, indices: np.ndarray) -> "BinnedDataset":
+        out = BinnedDataset()
+        out.num_data = len(indices)
+        out.num_total_features = self.num_total_features
+        out.bin_mappers = self.bin_mappers
+        out.used_feature_idx = self.used_feature_idx
+        out.inner_feature_map = self.inner_feature_map
+        out.num_bin_per_feature = self.num_bin_per_feature
+        out.feature_names = self.feature_names
+        out.binned = self.binned[indices]
+        out.metadata = self.metadata.subset(indices)
+        if self.raw_data is not None:
+            out.raw_data = self.raw_data[indices]
+        return out
+
+    def feature_infos(self) -> List[str]:
+        """Per-original-feature info strings for the model file
+        (gbdt_model_text.cpp feature_infos: ``[min:max]`` or category list)."""
+        infos = []
+        for m in self.bin_mappers:
+            if m.is_trivial:
+                infos.append("none")
+            elif m.bin_type == BinType.CATEGORICAL:
+                infos.append(":".join(str(c) for c in m.bin_2_categorical))
+            else:
+                infos.append("[%s:%s]" % (repr(m.min_val), repr(m.max_val)))
+        return infos

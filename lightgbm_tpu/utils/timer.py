@@ -1,0 +1,62 @@
+"""Name-keyed wall-clock aggregation for host-side profiling.
+
+Counterpart of the reference's ``Common::Timer``/``FunctionTimer``/``global_timer``
+(include/LightGBM/utils/common.h:1032-1093): hot host paths are instrumented with
+RAII-style scopes whose accumulated times can be printed at exit.  Device-side
+profiling is jax.profiler's job; this covers the host orchestration only.
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from contextlib import ContextDecorator
+
+
+class Timer:
+    def __init__(self) -> None:
+        self._starts: "OrderedDict[str, float]" = OrderedDict()
+        self._totals: "OrderedDict[str, float]" = OrderedDict()
+
+    def start(self, name: str) -> None:
+        self._starts[name] = time.perf_counter()
+
+    def stop(self, name: str) -> None:
+        if name in self._starts:
+            self._totals[name] = self._totals.get(name, 0.0) + (
+                time.perf_counter() - self._starts.pop(name))
+
+    def total(self, name: str) -> float:
+        return self._totals.get(name, 0.0)
+
+    def reset(self) -> None:
+        self._starts.clear()
+        self._totals.clear()
+
+    def summary(self) -> str:
+        lines = ["LightGBM-TPU host timing summary:"]
+        for name, tot in sorted(self._totals.items(), key=lambda kv: -kv[1]):
+            lines.append("  %s: %.6f s" % (name, tot))
+        return "\n".join(lines)
+
+    def print(self) -> None:
+        from .log import Log
+        Log.debug("%s", self.summary())
+
+
+global_timer = Timer()
+
+
+class FunctionTimer(ContextDecorator):
+    """``with FunctionTimer("name"):`` or ``@FunctionTimer("name")`` scope timer."""
+
+    def __init__(self, name: str, timer: Timer = global_timer) -> None:
+        self._name = name
+        self._timer = timer
+
+    def __enter__(self) -> "FunctionTimer":
+        self._timer.start(self._name)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._timer.stop(self._name)
+        return False

@@ -1,0 +1,149 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import (BinMapper, BinType, MissingType,
+                                     greedy_find_bin, find_bin_with_zero_as_one_bin)
+
+
+def test_greedy_few_distinct_values():
+    vals = np.array([1.0, 2.0, 3.0])
+    cnts = np.array([10, 10, 10])
+    bounds = greedy_find_bin(vals, cnts, max_bin=10, total_cnt=30, min_data_in_bin=3)
+    # midpoints (nudged one ulp up) + inf
+    assert len(bounds) == 3
+    assert bounds[0] == pytest.approx(1.5)
+    assert bounds[1] == pytest.approx(2.5)
+    assert bounds[2] == np.inf
+
+
+def test_greedy_min_data_in_bin_merges():
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    cnts = np.array([1, 1, 1, 100])
+    bounds = greedy_find_bin(vals, cnts, max_bin=10, total_cnt=103, min_data_in_bin=3)
+    # first three values merge until count >= 3
+    assert len(bounds) == 2
+    assert bounds[0] == pytest.approx(3.5)
+
+
+def test_greedy_many_distinct_respects_max_bin():
+    rng = np.random.RandomState(0)
+    vals = np.unique(rng.normal(size=5000))
+    cnts = np.ones(len(vals), dtype=np.int64)
+    bounds = greedy_find_bin(vals, cnts, max_bin=16, total_cnt=len(vals),
+                             min_data_in_bin=1)
+    assert len(bounds) <= 16
+    assert bounds[-1] == np.inf
+    assert all(bounds[i] < bounds[i + 1] for i in range(len(bounds) - 1))
+
+
+def test_zero_bin_separates_sign_regions():
+    vals = np.array([-3.0, -1.0, 2.0, 5.0])
+    cnts = np.array([5, 5, 5, 5])
+    bounds = find_bin_with_zero_as_one_bin(vals, cnts, max_bin=10,
+                                           total_sample_cnt=30, min_data_in_bin=1)
+    b = np.asarray(bounds)
+    # a boundary at -eps and +eps so zero has its own bin
+    assert (b == -1e-35).any() and (b == 1e-35).any()
+
+
+def test_bin_mapper_roundtrip_numerical():
+    rng = np.random.RandomState(42)
+    x = rng.normal(size=1000)
+    m = BinMapper()
+    m.find_bin(x[x != 0], total_sample_cnt=1000, max_bin=255)
+    assert not m.is_trivial
+    assert m.missing_type == MissingType.NONE
+    bins = m.values_to_bins(x)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+    # monotone: larger value -> same-or-larger bin
+    order = np.argsort(x)
+    assert (np.diff(bins[order]) >= 0).all()
+    # bin boundaries respected
+    for i in range(1000):
+        b = bins[i]
+        assert x[i] <= m.bin_upper_bound[b]
+        if b > 0:
+            assert x[i] > m.bin_upper_bound[b - 1]
+
+
+def test_bin_mapper_nan_gets_last_bin():
+    x = np.concatenate([np.arange(100, dtype=float) + 1.0, [np.nan] * 10])
+    m = BinMapper()
+    m.find_bin(x, total_sample_cnt=110, max_bin=32)
+    assert m.missing_type == MissingType.NAN
+    bins = m.values_to_bins(np.array([np.nan, 1.0]))
+    assert bins[0] == m.num_bin - 1
+    assert bins[1] != m.num_bin - 1
+
+
+def test_bin_mapper_zero_as_missing():
+    x = np.arange(1, 101, dtype=float)
+    m = BinMapper()
+    m.find_bin(x, total_sample_cnt=200, max_bin=32, zero_as_missing=True)
+    assert m.missing_type == MissingType.ZERO
+    assert m.values_to_bins(np.array([np.nan]))[0] == m.values_to_bins(np.array([0.0]))[0]
+
+
+def test_bin_mapper_trivial_constant():
+    m = BinMapper()
+    m.find_bin(np.array([]), total_sample_cnt=100, max_bin=255)  # all zeros
+    assert m.is_trivial
+
+
+def test_bin_mapper_trivial_by_min_split_filter():
+    # 99 zeros and a single 1.0: no boundary leaves >= 20 on both sides
+    m = BinMapper()
+    m.find_bin(np.array([1.0]), total_sample_cnt=100, max_bin=255,
+               min_split_data=20)
+    assert m.is_trivial
+
+
+def test_categorical_bins():
+    # category 7 most frequent, then 3, then 1; category 0 must not be bin 0
+    x = np.array([7] * 50 + [3] * 30 + [1] * 15 + [0] * 5, dtype=float)
+    m = BinMapper()
+    m.find_bin(x[x != 0], total_sample_cnt=100, max_bin=32,
+               bin_type=BinType.CATEGORICAL)
+    assert m.bin_type == BinType.CATEGORICAL
+    assert m.bin_2_categorical[0] == 7  # count-sorted
+    assert m.values_to_bins(np.array([7.0]))[0] == 0
+    # unseen category maps to last bin
+    assert m.values_to_bins(np.array([99.0]))[0] == m.num_bin - 1
+    # category 0 never in bin 0
+    assert m.values_to_bins(np.array([0.0]))[0] != 0
+
+
+def test_categorical_negative_goes_to_nan_bin():
+    x = np.array([1] * 50 + [2] * 30 + [-5] * 20, dtype=float)
+    m = BinMapper()
+    m.find_bin(x, total_sample_cnt=100, max_bin=32, bin_type=BinType.CATEGORICAL)
+    assert m.values_to_bins(np.array([-5.0]))[0] == m.num_bin - 1
+
+
+def test_most_freq_bin_and_sparse_rate():
+    # 90% zeros -> default bin is most frequent
+    x = np.array([1.0, 2.0, 3.0] * 10)
+    m = BinMapper()
+    m.find_bin(x, total_sample_cnt=300, max_bin=255)
+    assert m.most_freq_bin == m.default_bin
+    assert m.sparse_rate == pytest.approx(0.9)
+
+
+def test_serialization_roundtrip():
+    rng = np.random.RandomState(7)
+    x = rng.exponential(size=500)
+    m = BinMapper()
+    m.find_bin(x, total_sample_cnt=600, max_bin=63)
+    m2 = BinMapper.from_dict(m.to_dict())
+    test_vals = np.array([0.0, 0.5, 1.0, 10.0, np.nan])
+    np.testing.assert_array_equal(m.values_to_bins(test_vals),
+                                  m2.values_to_bins(test_vals))
+
+
+def test_forced_bins():
+    x = np.arange(1, 1001, dtype=float)
+    m = BinMapper()
+    m.find_bin(x, total_sample_cnt=1000, max_bin=16,
+               forced_upper_bounds=[250.0, 500.0])
+    assert 250.0 in m.bin_upper_bound
+    assert 500.0 in m.bin_upper_bound

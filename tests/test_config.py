@@ -1,0 +1,105 @@
+import pytest
+
+from lightgbm_tpu.config import Config, alias_transform, parse_config_file
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def test_defaults():
+    c = Config()
+    assert c.num_iterations == 100
+    assert c.learning_rate == 0.1
+    assert c.num_leaves == 31
+    assert c.max_bin == 255
+    assert c.min_data_in_leaf == 20
+    assert c.min_sum_hessian_in_leaf == 1e-3
+    assert c.tree_learner == "serial"
+    assert c.objective == "regression"
+    assert c.boosting == "gbdt"
+    assert c.num_machines == 1
+    assert c.local_listen_port == 12400
+    assert c.top_k == 20
+    assert c.metric == ["l2"]
+
+
+def test_aliases():
+    c = Config({"n_estimators": 50, "eta": 0.3, "num_leaf": 7, "min_child_samples": 5,
+                "subsample": 0.5, "colsample_bytree": 0.8, "reg_alpha": 1.0,
+                "reg_lambda": 2.0, "random_state": 42, "nthreads": 4})
+    assert c.num_iterations == 50
+    assert c.learning_rate == 0.3
+    assert c.num_leaves == 7
+    assert c.min_data_in_leaf == 5
+    assert c.bagging_fraction == 0.5
+    assert c.feature_fraction == 0.8
+    assert c.lambda_l1 == 1.0
+    assert c.lambda_l2 == 2.0
+    assert c.seed == 42
+    assert c.num_threads == 4
+
+
+def test_alias_conflict_keeps_canonical():
+    out = alias_transform({"num_iterations": 10, "n_estimators": 99})
+    assert out["num_iterations"] == 10
+
+
+def test_objective_normalization():
+    assert Config({"objective": "mse"}).objective == "regression"
+    assert Config({"objective": "mae"}).objective == "regression_l1"
+    assert Config({"objective": "softmax", "num_class": 3}).objective == "multiclass"
+    assert Config({"objective": "xentropy"}).objective == "cross_entropy"
+    assert Config({"objective": "none"}).objective == "custom"
+
+
+def test_metric_normalization_and_defaults():
+    c = Config({"objective": "binary"})
+    assert c.metric == ["binary_logloss"]
+    c = Config({"objective": "lambdarank"})
+    assert c.metric == ["ndcg"]
+    c = Config({"objective": "binary", "metric": "auc,binary_logloss,auc"})
+    assert c.metric == ["auc", "binary_logloss"]
+    c = Config({"objective": "regression", "metric": ["rmse", "mae"]})
+    assert c.metric == ["rmse", "l1"]
+
+
+def test_boosting_and_tree_learner_aliases():
+    assert Config({"boosting": "gbrt"}).boosting == "gbdt"
+    assert Config({"boosting": "random_forest", "bagging_freq": 1,
+                   "bagging_fraction": 0.5, "feature_fraction": 0.8}).boosting == "rf"
+    assert Config({"tree_learner": "data_parallel"}).tree_learner == "data"
+    assert Config({"tree_learner": "voting_parallel"}).tree_learner == "voting"
+
+
+def test_device_type():
+    assert Config({"device": "gpu"}).device_type == "tpu"
+    assert Config({"device": "cpu"}).device_type == "cpu"
+
+
+def test_checks_raise():
+    with pytest.raises(LightGBMError):
+        Config({"num_leaves": 1})
+    with pytest.raises(LightGBMError):
+        Config({"bagging_fraction": 1.5})
+    with pytest.raises(LightGBMError):
+        Config({"objective": "multiclass"})  # num_class missing
+
+
+def test_type_coercion():
+    c = Config({"num_leaves": "15", "learning_rate": "0.05", "is_unbalance": "true",
+                "eval_at": "1,3,5"})
+    assert c.num_leaves == 15
+    assert c.learning_rate == 0.05
+    assert c.is_unbalance is True
+    assert c.eval_at == [1, 3, 5]
+
+
+def test_config_file_parse(tmp_path):
+    p = tmp_path / "train.conf"
+    p.write_text("task = train\n# comment\nobjective = binary  # trailing\n"
+                 "num_trees = 25\n\nbad line without equals maybe\n")
+    kv = parse_config_file(str(p))
+    assert kv["task"] == "train"
+    assert kv["objective"] == "binary"
+    assert kv["num_trees"] == "25"
+    c = Config(kv)
+    assert c.task == "train"
+    assert c.num_iterations == 25

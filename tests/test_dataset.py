@@ -1,0 +1,94 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.metadata import Metadata
+
+
+def make_data(n=500, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def test_from_matrix_basic():
+    X, y = make_data()
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    assert ds.num_data == 500
+    assert ds.num_features == 5
+    assert ds.binned.shape == (500, 5)
+    assert ds.binned.dtype == np.uint8
+    assert all(nb <= 63 for nb in ds.num_bin_per_feature)
+    np.testing.assert_array_equal(ds.metadata.label, y)
+
+
+def test_trivial_feature_dropped():
+    X, y = make_data()
+    X = np.concatenate([X, np.ones((len(X), 1))], axis=1)  # constant column
+    ds = BinnedDataset.from_matrix(X, label=y)
+    assert ds.num_total_features == 6
+    assert ds.num_features == 5
+    assert 5 not in ds.used_feature_idx
+
+
+def test_validation_alignment():
+    X, y = make_data()
+    Xv, yv = make_data(seed=1)
+    train = BinnedDataset.from_matrix(X, label=y, max_bin=31)
+    valid = BinnedDataset.from_matrix(Xv, label=yv, reference=train)
+    assert valid.bin_mappers is train.bin_mappers
+    # same value must land in the same bin in both datasets
+    v = X[7, 2]
+    b_train = train.bin_mappers[2].value_to_bin(v)
+    b_valid = valid.bin_mappers[2].value_to_bin(v)
+    assert b_train == b_valid
+
+
+def test_binary_roundtrip(tmp_path):
+    X, y = make_data()
+    w = np.abs(np.random.RandomState(3).normal(size=len(y))).astype(np.float32)
+    ds = BinnedDataset.from_matrix(X, label=y, weight=w, max_bin=15)
+    path = str(tmp_path / "ds.bin")
+    ds.save_binary(path)
+    ds2 = BinnedDataset.load_binary(path)
+    np.testing.assert_array_equal(ds.binned, ds2.binned)
+    np.testing.assert_array_equal(ds.metadata.label, ds2.metadata.label)
+    np.testing.assert_array_equal(ds.metadata.weights, ds2.metadata.weights)
+    assert ds2.num_bin_per_feature == ds.num_bin_per_feature
+
+
+def test_subset():
+    X, y = make_data()
+    ds = BinnedDataset.from_matrix(X, label=y)
+    idx = np.arange(0, 500, 2)
+    sub = ds.subset(idx)
+    assert sub.num_data == 250
+    np.testing.assert_array_equal(sub.binned, ds.binned[idx])
+    np.testing.assert_array_equal(sub.metadata.label, y[idx])
+
+
+def test_metadata_groups():
+    md = Metadata(10)
+    md.set_group([4, 3, 3])
+    np.testing.assert_array_equal(md.query_boundaries, [0, 4, 7, 10])
+    assert md.num_queries == 3
+    md2 = Metadata(10)
+    md2.set_query_ids([1, 1, 1, 1, 2, 2, 2, 5, 5, 5])
+    np.testing.assert_array_equal(md2.query_boundaries, [0, 4, 7, 10])
+
+
+def test_metadata_query_weights():
+    md = Metadata(6)
+    md.set_group([3, 3])
+    md.set_weights(np.array([1, 2, 3, 4, 5, 6], dtype=np.float32))
+    np.testing.assert_allclose(md.query_weights, [2.0, 5.0])
+
+
+def test_categorical_feature_in_dataset():
+    rng = np.random.RandomState(0)
+    X = np.stack([rng.normal(size=300),
+                  rng.choice([1, 2, 3, 7], size=300).astype(float)], axis=1)
+    y = rng.normal(size=300).astype(np.float32)
+    ds = BinnedDataset.from_matrix(X, label=y, categorical_feature=[1])
+    assert ds.feature_is_categorical().tolist() == [False, True]
