@@ -1,0 +1,106 @@
+"""Feature-histogram construction — the hottest op (SURVEY.md §3.1).
+
+Counterpart of the reference's histogram kernels: the CPU ``Bin::ConstructHistogram``
+family (src/io/dense_bin.hpp:48, src/io/dataset.cpp:1265,1370) and the OpenCL
+``histogram256`` kernels (src/treelearner/ocl/histogram256.cl:317).
+
+TPU-first design: TPUs have no fast scatter-add, so instead of per-workgroup local
+histograms with float atomics (histogram256.cl:100-130) the histogram is computed as
+a one-hot contraction per feature tile — compare a bin tile against an iota to get a
+``[rows, bins]`` one-hot and contract it with the (grad, hess) pair on the MXU/VPU.
+Accumulation order is fixed by the sequential TPU grid, so results are deterministic
+(unlike the reference GPU path's atomic adds).
+
+Two channels per bin — (sum_grad, sum_hess) — matching the reference's 16-byte
+histogram entry (bin.h:41 ``HistogramSumReducer``); bin counts are derived from
+hessians downstream exactly like feature_histogram.hpp:535 ``cnt_factor``.
+
+Leaf membership / bagging are handled by pre-masking grad/hess to zero, so the
+kernel itself is mask-free and shape-static.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANE = 128
+
+
+def _pad_bins(num_bins: int) -> int:
+    return max(_LANE, -(-num_bins // _LANE) * _LANE)
+
+
+def histogram_xla(bins: jax.Array, values: jax.Array, num_bins: int) -> jax.Array:
+    """Reference implementation via segment-sum; runs on any backend.
+
+    bins: [N, F] integer; values: [N, 2] f32 (grad, hess; pre-masked).
+    Returns [F, 2, num_bins] f32.
+    """
+    n, f = bins.shape
+    ids = bins.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    vals = jnp.broadcast_to(values[:, None, :], (n, f, 2)).reshape(n * f, 2)
+    hist = jax.ops.segment_sum(vals, ids.reshape(-1), num_segments=f * num_bins)
+    return hist.reshape(f, num_bins, 2).transpose(0, 2, 1)
+
+
+def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_features: int, num_bins: int):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[...].astype(jnp.int32)          # [Nt, F]
+    vals = vals_ref[...]                            # [Nt, 2]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
+
+    def body(f, _):
+        col = jax.lax.dynamic_slice_in_dim(bins, f, 1, axis=1)      # [Nt, 1]
+        onehot = (col == iota).astype(jnp.float32)                  # [Nt, B]
+        acc = jax.lax.dot_general(vals, onehot, (((0,), (0,)), ((), ())),
+                                  precision=jax.lax.Precision.HIGHEST,
+                                  preferred_element_type=jnp.float32)  # [2, B]
+        out_ref[pl.ds(f, 1), :, :] += acc[None]
+        return 0
+
+    jax.lax.fori_loop(0, num_features, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile", "interpret"))
+def histogram_pallas(bins: jax.Array, values: jax.Array, num_bins: int,
+                     row_tile: int = 2048, interpret: bool = False) -> jax.Array:
+    """Pallas TPU histogram: grid over row tiles, one-hot contraction per feature.
+
+    bins: [N, F] int (any small int dtype); values: [N, 2] f32.
+    Returns [F, 2, num_bins] f32.  N must be a multiple of row_tile (pad with
+    zero-valued rows).
+    """
+    n, f = bins.shape
+    assert n % row_tile == 0, "pad rows to a multiple of row_tile"
+    grid = (n // row_tile,)
+    kernel = functools.partial(_hist_kernel, num_features=f, num_bins=num_bins)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((row_tile, f), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, 2), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((f, 2, num_bins), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f, 2, num_bins), jnp.float32),
+        interpret=interpret,
+    )(bins.astype(jnp.int32), values)
+
+
+def build_histogram(bins: jax.Array, values: jax.Array, num_bins: int,
+                    use_pallas: bool | None = None) -> jax.Array:
+    """Dispatch: Pallas on TPU, segment-sum elsewhere.  [F, 2, B] f32 output."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        n = bins.shape[0]
+        tile = 2048 if n % 2048 == 0 else (1024 if n % 1024 == 0 else None)
+        if tile is not None:
+            return histogram_pallas(bins, values, num_bins, row_tile=tile)
+    return histogram_xla(bins, values, num_bins)

@@ -1,0 +1,200 @@
+import numpy as np
+import jax.numpy as jnp
+
+from lightgbm_tpu.core.split import (SplitParams, FeatureInfo, best_split_numerical,
+                                     threshold_l1, calculate_leaf_output)
+from lightgbm_tpu.io.binning import MissingType
+
+
+def brute_force_best(hist, num_bin, params, sum_g, sum_h, n, missing=None,
+                     default_bin=None):
+    """Straight-line reimplementation of the reference scan semantics for tests."""
+    eps = 1e-15
+    l1, l2, mds = params.lambda_l1, params.lambda_l2, params.max_delta_step
+
+    def out(g, h):
+        r = -np.sign(g) * max(abs(g) - l1, 0.0) / (h + l2)
+        if mds > 0:
+            r = np.clip(r, -mds, mds)
+        return r
+
+    def gain_go(g, h, o):
+        sg = np.sign(g) * max(abs(g) - l1, 0.0)
+        return -(2 * sg * o + (h + l2) * o * o)
+
+    def gain(gl, hl, gr, hr):
+        return gain_go(gl, hl, out(gl, hl)) + gain_go(gr, hr, out(gr, hr))
+
+    total_h = sum_h + 2 * eps
+    shift = gain_go(sum_g, total_h, out(sum_g, total_h)) + params.min_gain_to_split
+    cnt_factor = n / total_h
+    F, _, B = hist.shape
+    best = (-np.inf, -1, -1, True)
+    for f in range(F):
+        nb = num_bin[f]
+        g = hist[f, 0]
+        h = hist[f, 1]
+        c = np.round(h * cnt_factor)
+        mt = missing[f] if missing is not None else MissingType.NONE
+        dbin = default_bin[f] if default_bin is not None else 0
+        candidates = []
+        if mt == MissingType.NONE or nb <= 2:
+            for t in range(nb - 1):
+                gl = g[:t + 1].sum(); hl = h[:t + 1].sum() + eps; cl = c[:t + 1].sum()
+                candidates.append((t, sum_g - gl, total_h - hl, n - cl, gl, hl, cl,
+                                   not (mt == MissingType.NAN and nb <= 2)))
+        elif mt == MissingType.NAN:
+            for t in range(nb - 2):   # missing left
+                gr = g[t + 1:nb - 1].sum(); hr = h[t + 1:nb - 1].sum() + eps
+                cr = c[t + 1:nb - 1].sum()
+                candidates.append((t, gr, hr, cr, sum_g - gr, total_h - hr, n - cr,
+                                   True))
+            for t in range(nb - 1):   # missing right
+                gl = g[:t + 1].sum(); hl = h[:t + 1].sum() + eps; cl = c[:t + 1].sum()
+                candidates.append((t, sum_g - gl, total_h - hl, n - cl, gl, hl, cl,
+                                   False))
+        elif mt == MissingType.ZERO:
+            sel = [b for b in range(nb) if b != dbin]
+            for t in range(nb - 1):   # missing left
+                if t == dbin - 1:
+                    continue
+                gr = sum(g[b] for b in sel if b > t); hr = sum(h[b] for b in sel if b > t) + eps
+                cr = sum(c[b] for b in sel if b > t)
+                candidates.append((t, gr, hr, cr, sum_g - gr, total_h - hr, n - cr,
+                                   True))
+            for t in range(nb - 1):   # missing right
+                if t == dbin:
+                    continue
+                gl = sum(g[b] for b in sel if b <= t); hl = sum(h[b] for b in sel if b <= t) + eps
+                cl = sum(c[b] for b in sel if b <= t)
+                candidates.append((t, sum_g - gl, total_h - hl, n - cl, gl, hl, cl,
+                                   False))
+        for (t, gr, hr, cr, gl, hl, cl, dl) in candidates:
+            if cl < params.min_data_in_leaf or cr < params.min_data_in_leaf:
+                continue
+            if hl < params.min_sum_hessian_in_leaf or hr < params.min_sum_hessian_in_leaf:
+                continue
+            cur = gain(gl, hl, gr, hr)
+            if cur <= shift:
+                continue
+            if cur > best[0] + 1e-10:
+                best = (cur, f, t, dl)
+    return best
+
+
+def run_case(seed=0, F=4, B=16, n=200, missing=None, default_bin=None, **kw):
+    rng = np.random.RandomState(seed)
+    params = SplitParams(min_data_in_leaf=2, min_sum_hessian_in_leaf=1e-3, **kw)
+    num_bin = np.full(F, B, dtype=np.int32)
+    hist = np.zeros((F, 2, B), dtype=np.float32)
+    hist[:, 0] = rng.normal(size=(F, B)) * 3
+    hist[:, 1] = rng.uniform(0.5, 2.0, size=(F, B))
+    sum_g = float(hist[0, 0].sum())
+    sum_h = float(hist[0, 1].sum())
+    # make all features share the same totals (as a real leaf histogram would)
+    for f in range(1, F):
+        hist[f, 0] *= sum_g / hist[f, 0].sum() if hist[f, 0].sum() != 0 else 1
+        hist[f, 1] *= sum_h / hist[f, 1].sum()
+    mt = (np.full(F, int(MissingType.NONE), dtype=np.int32) if missing is None
+          else np.asarray([int(m) for m in missing], dtype=np.int32))
+    dbin = (np.zeros(F, dtype=np.int32) if default_bin is None
+            else np.asarray(default_bin, dtype=np.int32))
+    feat = FeatureInfo(num_bin=jnp.asarray(num_bin), missing_type=jnp.asarray(mt),
+                       default_bin=jnp.asarray(dbin),
+                       is_categorical=jnp.zeros(F, dtype=bool))
+    got = best_split_numerical(jnp.asarray(hist), feat, jnp.ones(F, dtype=bool),
+                               jnp.float32(sum_g), jnp.float32(sum_h),
+                               jnp.int32(n), params)
+    missing_list = None if missing is None else list(missing)
+    dbin_list = None if default_bin is None else list(dbin)
+    want = brute_force_best(hist.astype(np.float64), num_bin, params, sum_g, sum_h,
+                            n, missing_list, dbin_list)
+    return got, want, params
+
+
+def test_matches_bruteforce_no_missing():
+    for seed in range(5):
+        got, want, params = run_case(seed=seed)
+        assert int(got.feature) == want[1], seed
+        assert int(got.threshold) == want[2], seed
+        assert bool(got.default_left) == want[3]
+
+
+def test_matches_bruteforce_nan_missing():
+    for seed in range(5):
+        got, want, _ = run_case(seed=seed + 10,
+                                missing=[MissingType.NAN] * 4)
+        assert int(got.feature) == want[1], seed
+        assert int(got.threshold) == want[2], seed
+        assert bool(got.default_left) == want[3], seed
+
+
+def test_matches_bruteforce_zero_missing():
+    for seed in range(5):
+        got, want, _ = run_case(seed=seed + 20,
+                                missing=[MissingType.ZERO] * 4,
+                                default_bin=[3, 3, 3, 3])
+        assert int(got.feature) == want[1], seed
+        assert int(got.threshold) == want[2], seed
+        assert bool(got.default_left) == want[3], seed
+
+
+def test_l1_l2_regularization():
+    got_plain, _, _ = run_case(seed=1)
+    got_l2, want_l2, _ = run_case(seed=1, lambda_l2=5.0)
+    assert float(got_l2.gain) < float(got_plain.gain)
+    assert int(got_l2.feature) == want_l2[1]
+    got_l1, want_l1, _ = run_case(seed=1, lambda_l1=2.0)
+    assert int(got_l1.feature) == want_l1[1]
+    assert int(got_l1.threshold) == want_l1[2]
+
+
+def test_min_data_blocks_splits():
+    # with a huge min_data_in_leaf nothing is valid
+    rng = np.random.RandomState(0)
+    F, B, n = 3, 8, 50
+    hist = np.abs(rng.normal(size=(F, 2, B))).astype(np.float32)
+    feat = FeatureInfo(num_bin=jnp.full(F, B, dtype=jnp.int32),
+                       missing_type=jnp.zeros(F, dtype=jnp.int32),
+                       default_bin=jnp.zeros(F, dtype=jnp.int32),
+                       is_categorical=jnp.zeros(F, dtype=bool))
+    params = SplitParams(min_data_in_leaf=1000)
+    got = best_split_numerical(jnp.asarray(hist), feat, jnp.ones(F, dtype=bool),
+                               jnp.float32(hist[0, 0].sum()),
+                               jnp.float32(hist[0, 1].sum()), jnp.int32(n), params)
+    assert not bool(np.isfinite(np.asarray(got.gain)))
+
+
+def test_feature_mask_respected():
+    got, want, _ = run_case(seed=3)
+    f_best = int(got.feature)
+    F = 4
+    mask = np.ones(F, dtype=bool)
+    mask[f_best] = False
+    rng = np.random.RandomState(3)
+    # re-run with the winning feature masked out: must pick another feature
+    params = SplitParams(min_data_in_leaf=2)
+    num_bin = np.full(F, 16, dtype=np.int32)
+    hist = np.zeros((F, 2, 16), dtype=np.float32)
+    hist[:, 0] = rng.normal(size=(F, 16)) * 3
+    hist[:, 1] = rng.uniform(0.5, 2.0, size=(F, 16))
+    sum_g = float(hist[0, 0].sum()); sum_h = float(hist[0, 1].sum())
+    for f in range(1, F):
+        hist[f, 0] *= sum_g / hist[f, 0].sum() if hist[f, 0].sum() != 0 else 1
+        hist[f, 1] *= sum_h / hist[f, 1].sum()
+    feat = FeatureInfo(num_bin=jnp.asarray(num_bin),
+                       missing_type=jnp.zeros(F, dtype=jnp.int32),
+                       default_bin=jnp.zeros(F, dtype=jnp.int32),
+                       is_categorical=jnp.zeros(F, dtype=bool))
+    got2 = best_split_numerical(jnp.asarray(hist), feat, jnp.asarray(mask),
+                                jnp.float32(sum_g), jnp.float32(sum_h),
+                                jnp.int32(200), params)
+    assert int(got2.feature) != f_best
+
+
+def test_gain_helpers():
+    assert threshold_l1(5.0, 2.0) == 3.0
+    assert threshold_l1(-5.0, 2.0) == -3.0
+    assert threshold_l1(1.0, 2.0) == 0.0
+    assert float(calculate_leaf_output(4.0, 2.0, 0.0, 0.0, 0.0)) == -2.0
+    assert float(calculate_leaf_output(4.0, 2.0, 0.0, 0.0, 1.0)) == -1.0
