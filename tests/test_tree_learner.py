@@ -1,0 +1,126 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.tree_learner import SerialTreeLearner, route_binned
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def build_learner(X, y, **params):
+    merged = {"min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 1e-3}
+    merged.update(params)
+    cfg = Config(merged)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
+                                   min_data_in_leaf=cfg.min_data_in_leaf)
+    return SerialTreeLearner(ds, cfg), ds
+
+
+def l2_grads(y, score):
+    return (score - y).astype(np.float32), np.ones_like(y, dtype=np.float32)
+
+
+def test_single_split_recovers_step_function():
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, size=(400, 1))
+    y = np.where(X[:, 0] > 0, 1.0, -1.0).astype(np.float32)
+    learner, ds = build_learner(X, y, num_leaves=2)
+    g, h = l2_grads(y, np.zeros_like(y))
+    arrays = learner.train(jnp.asarray(g), jnp.asarray(h), len(y))
+    assert int(arrays.num_leaves) == 2
+    tree = learner.host_tree(arrays)
+    # threshold near zero, leaves near +/-1 (leaf output = -G/H = mean(y))
+    assert abs(tree.threshold[0]) < 0.1
+    vals = sorted(tree.leaf_value[:2])
+    assert vals[0] == pytest.approx(-1.0, abs=1e-5)
+    assert vals[1] == pytest.approx(1.0, abs=1e-5)
+    # row assignment consistent with sign
+    row_leaf = np.asarray(arrays.row_leaf)
+    leaf_vals = np.asarray(arrays.leaf_value)[row_leaf]
+    np.testing.assert_allclose(leaf_vals, y, atol=1e-5)
+
+
+def test_additive_step_function_four_leaves():
+    rng = np.random.RandomState(1)
+    X = rng.uniform(-1, 1, size=(1000, 2))
+    y = (np.sign(X[:, 0]) + 2.0 * np.sign(X[:, 1])).astype(np.float32)
+    learner, ds = build_learner(X, y, num_leaves=4)
+    g, h = l2_grads(y, np.zeros_like(y))
+    arrays = learner.train(jnp.asarray(g), jnp.asarray(h), len(y))
+    assert int(arrays.num_leaves) == 4
+    row_leaf = np.asarray(arrays.row_leaf)
+    pred = np.asarray(arrays.leaf_value)[row_leaf]
+    assert np.abs(pred - y).mean() < 0.05
+
+
+def test_no_split_when_constant_target():
+    X = np.random.RandomState(2).uniform(size=(100, 3))
+    y = np.zeros(100, dtype=np.float32)
+    learner, ds = build_learner(X, y, num_leaves=8)
+    g, h = l2_grads(y, np.zeros_like(y))
+    arrays = learner.train(jnp.asarray(g), jnp.asarray(h), len(y))
+    assert int(arrays.num_leaves) == 1
+
+
+def test_min_data_in_leaf_respected():
+    rng = np.random.RandomState(3)
+    X = rng.uniform(-1, 1, size=(100, 1))
+    y = rng.normal(size=100).astype(np.float32)
+    learner, ds = build_learner(X, y, num_leaves=16, min_data_in_leaf=30)
+    g, h = l2_grads(y, np.zeros_like(y))
+    arrays = learner.train(jnp.asarray(g), jnp.asarray(h), len(y))
+    counts = np.asarray(arrays.leaf_count)[:int(arrays.num_leaves)]
+    assert (counts >= 30).all()
+
+
+def test_max_depth_limits_tree():
+    rng = np.random.RandomState(4)
+    X = rng.uniform(-1, 1, size=(500, 3))
+    y = (X[:, 0] + np.sin(3 * X[:, 1])).astype(np.float32)
+    learner, ds = build_learner(X, y, num_leaves=32, max_depth=2)
+    g, h = l2_grads(y, np.zeros_like(y))
+    arrays = learner.train(jnp.asarray(g), jnp.asarray(h), len(y))
+    depths = np.asarray(arrays.leaf_depth)[:int(arrays.num_leaves)]
+    assert depths.max() <= 2
+    assert int(arrays.num_leaves) <= 4
+
+
+def test_route_binned_matches_training_assignment():
+    rng = np.random.RandomState(5)
+    X = rng.uniform(-1, 1, size=(300, 4))
+    y = (X[:, 0] > 0.3).astype(np.float32)
+    learner, ds = build_learner(X, y, num_leaves=8)
+    g, h = l2_grads(y, np.zeros_like(y))
+    arrays = learner.train(jnp.asarray(g), jnp.asarray(h), len(y))
+    routed = np.asarray(route_binned(learner.bins, arrays, learner.feat,
+                                     num_leaves=learner.num_leaves))
+    np.testing.assert_array_equal(routed, np.asarray(arrays.row_leaf))
+
+
+def test_host_tree_predict_matches_device_assignment():
+    rng = np.random.RandomState(6)
+    X = rng.uniform(-1, 1, size=(300, 4))
+    y = (X[:, 0] * 2 + X[:, 1]).astype(np.float32)
+    learner, ds = build_learner(X, y, num_leaves=8)
+    g, h = l2_grads(y, np.zeros_like(y))
+    arrays = learner.train(jnp.asarray(g), jnp.asarray(h), len(y))
+    tree = learner.host_tree(arrays)
+    host_pred = tree.predict(X)
+    dev_pred = np.asarray(arrays.leaf_value)[np.asarray(arrays.row_leaf)]
+    np.testing.assert_allclose(host_pred, dev_pred, rtol=1e-5, atol=1e-6)
+
+
+def test_tree_serialization_roundtrip():
+    rng = np.random.RandomState(7)
+    X = rng.uniform(-1, 1, size=(200, 3))
+    y = (X[:, 0] + 0.2 * X[:, 2]).astype(np.float32)
+    learner, ds = build_learner(X, y, num_leaves=6)
+    g, h = l2_grads(y, np.zeros_like(y))
+    arrays = learner.train(jnp.asarray(g), jnp.asarray(h), len(y))
+    tree = learner.host_tree(arrays, shrinkage=0.1)
+    text = tree.to_string()
+    from lightgbm_tpu.core.tree import Tree
+    tree2 = Tree.from_string(text)
+    np.testing.assert_allclose(tree2.predict(X), tree.predict(X), rtol=1e-6)
+    assert tree2.num_leaves == tree.num_leaves
+    assert tree2.shrinkage == pytest.approx(0.1)
