@@ -1,0 +1,19 @@
+"""Boosting factory (src/boosting/boosting.cpp:35-68)."""
+from __future__ import annotations
+
+from .gbdt import GBDT
+from .dart import DART
+from .goss import GOSS
+from .rf import RF
+from ..utils.log import Log
+
+
+def create_boosting(boosting_type: str, config, dataset=None, objective=None):
+    table = {"gbdt": GBDT, "dart": DART, "goss": GOSS, "rf": RF}
+    cls = table.get(boosting_type)
+    if cls is None:
+        Log.fatal("Unknown boosting type %s", boosting_type)
+    return cls(config, dataset, objective)
+
+
+__all__ = ["GBDT", "DART", "GOSS", "RF", "create_boosting"]

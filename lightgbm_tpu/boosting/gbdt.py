@@ -1,0 +1,609 @@
+"""GBDT training loop.
+
+Counterpart of the reference ``GBDT`` (src/boosting/gbdt.cpp, gbdt.h):
+``train_one_iter`` = boost-from-average (first iter) -> objective gradients ->
+bagging -> per-class tree train -> leaf-output renewal -> shrinkage -> score
+update (gbdt.cpp:370-452); plus bagging (:160-276), early stopping (:472-489),
+rollback (:454), snapshots (:291-295) and the reference-compatible text model
+format (gbdt_model_text.cpp:271,375).
+
+TPU-first notes:
+- Scores live on device as [num_tree_per_iteration, padded_rows] f32; the train
+  score update is a leaf-value gather through the freshly built tree's
+  ``row_leaf`` (free by-product of the on-device build), validation scores come
+  from ``route_binned`` — no host round-trip per iteration except for metrics.
+- Bagging is a row mask multiplied into grad/hess (histograms are mask-blind),
+  not an index-compacted subset; ``bag_data_cnt`` feeds min_data_in_leaf
+  semantics exactly like the reference's ``bag_data_cnt_``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..core.tree import Tree
+from ..core.tree_learner import (SerialTreeLearner, TreeArrays, route_binned,
+                                 tree_from_arrays)
+from ..io.dataset import BinnedDataset
+from ..metric.metric import Metric, create_metrics
+from ..objective import ObjectiveFunction, create_objective
+from ..utils.log import Log
+from ..utils.timer import FunctionTimer
+
+K_EPSILON = 1e-15
+MODEL_VERSION = "v3"
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree (sub-model name "tree", gbdt.h:362)."""
+
+    average_output = False
+
+    def __init__(self, config: Config, train_data: Optional[BinnedDataset] = None,
+                 objective: Optional[ObjectiveFunction] = None) -> None:
+        self.config = config
+        self.models: List[Tree] = []
+        self.iter_ = 0
+        self.num_init_iteration = 0
+        self.train_data: Optional[BinnedDataset] = None
+        self.objective = objective
+        self.num_tree_per_iteration = 1
+        self.num_class = int(config.num_class)
+        self.shrinkage_rate = float(config.learning_rate)
+        self.max_feature_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.label_idx = 0
+        self.best_score: Dict = {}
+        self.valid_sets: List[dict] = []
+        self.train_metrics: List[Metric] = []
+        self._loaded_params: Dict[str, str] = {}
+        if train_data is not None:
+            self.reset_training_data(train_data, objective)
+
+    # ---- setup ----
+
+    def reset_training_data(self, train_data: BinnedDataset,
+                            objective: Optional[ObjectiveFunction]) -> None:
+        self.train_data = train_data
+        self.objective = objective
+        self.num_data = train_data.num_data
+        self.num_tree_per_iteration = (objective.num_model_per_iteration
+                                       if objective else max(1, self.num_class))
+        self.learner = SerialTreeLearner(train_data, self.config)
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.feature_names = list(train_data.feature_names)
+        self.feature_infos = train_data.feature_infos()
+        np_total = self.num_data + self.learner.padded_rows
+        self.train_score = jnp.zeros(
+            (self.num_tree_per_iteration, np_total), dtype=jnp.float32)
+        if train_data.metadata.init_score is not None:
+            init = np.asarray(train_data.metadata.init_score, dtype=np.float32)
+            init = init.reshape(self.num_tree_per_iteration, self.num_data)
+            pad = np.zeros((self.num_tree_per_iteration, self.learner.padded_rows),
+                           dtype=np.float32)
+            self.train_score = jnp.asarray(np.concatenate([init, pad], axis=1))
+            self._has_init_score = True
+        else:
+            self._has_init_score = False
+        self.class_need_train = [True] * self.num_tree_per_iteration
+        if self.objective is not None:
+            self.objective.init(train_data.metadata, self.num_data)
+            if hasattr(self.objective, "class_need_train"):
+                self.class_need_train = [
+                    self.objective.class_need_train(k)
+                    for k in range(self.num_tree_per_iteration)]
+        self.train_metrics = []
+        self._bag_rng = np.random.RandomState(int(self.config.bagging_seed))
+        self._feat_rng = np.random.RandomState(
+            int(self.config.feature_fraction_seed))
+        self.bag_mask: Optional[jnp.ndarray] = None
+        self.bag_data_cnt = self.num_data
+        self._boosted_from_average = False
+        self._last_iter_arrays: List[Optional[TreeArrays]] = []
+        # gradients cache for custom-objective path
+        self._es_state: Dict = {}
+
+    def add_train_metrics(self, metrics: Sequence[Metric]) -> None:
+        self.train_metrics = list(metrics)
+        for m in self.train_metrics:
+            m.init(self.train_data.metadata, self.num_data)
+
+    def add_valid_data(self, valid_data: BinnedDataset, name: str,
+                       metrics: Optional[Sequence[Metric]] = None) -> None:
+        if metrics is None:
+            metrics = create_metrics(self.config.metric, self.config)
+        for m in metrics:
+            m.init(valid_data.metadata, valid_data.num_data)
+        score = jnp.zeros((self.num_tree_per_iteration, valid_data.num_data),
+                          dtype=jnp.float32)
+        if valid_data.metadata.init_score is not None:
+            init = np.asarray(valid_data.metadata.init_score, dtype=np.float32)
+            score = jnp.asarray(init.reshape(self.num_tree_per_iteration,
+                                             valid_data.num_data))
+        self.valid_sets.append({
+            "name": name, "data": valid_data,
+            "bins": jnp.asarray(valid_data.binned),
+            "metrics": list(metrics), "score": score,
+        })
+        # replay existing model onto the new validation set
+        for i, tree in enumerate(self.models):
+            k = i % self.num_tree_per_iteration
+            self._add_tree_score_valid(-1, tree, k, vs=self.valid_sets[-1])
+
+    # ---- scores ----
+
+    def _gather_tree_output(self, arrays: TreeArrays) -> jnp.ndarray:
+        return arrays.leaf_value[arrays.row_leaf]
+
+    def _tree_to_device(self, tree: Tree) -> TreeArrays:
+        """Rebuild a device-routable TreeArrays from a host tree (bin thresholds)."""
+        nl = tree.num_leaves
+        L = max(nl, 2)
+        z = lambda dt: jnp.zeros((L,), dtype=dt)
+        pad = lambda a, dt: jnp.asarray(
+            np.concatenate([np.asarray(a[:max(nl - 1, 0)]),
+                            np.zeros(L - max(nl - 1, 0), dtype=np.asarray(a).dtype)]
+                           ).astype(dt))
+        padl = lambda a, dt: jnp.asarray(
+            np.concatenate([np.asarray(a[:nl]),
+                            np.zeros(L - nl, dtype=np.asarray(a).dtype)]).astype(dt))
+        ni = max(nl - 1, 0)
+        inner = np.asarray([self.train_data.inner_feature_map.get(int(f), 0)
+                            for f in tree.split_feature[:ni]],
+                           dtype=np.int32) if self.train_data else \
+            tree.split_feature_inner[:ni]
+        # recompute bin thresholds from real-valued thresholds so parsed models
+        # (whose text form stores only real thresholds) route identically
+        thr_bin = np.zeros(ni, dtype=np.int32)
+        for node in range(ni):
+            m = self.train_data.bin_mappers[int(tree.split_feature[node])]
+            thr_bin[node] = m.value_to_bin(float(tree.threshold[node]))
+        return TreeArrays(
+            split_feature=pad(inner, np.int32),
+            threshold_bin=pad(thr_bin, np.int32),
+            split_gain=pad(tree.split_gain, np.float32),
+            default_left=pad((tree.decision_type & 2) > 0, bool),
+            left_child=pad(tree.left_child, np.int32),
+            right_child=pad(tree.right_child, np.int32),
+            internal_value=pad(tree.internal_value, np.float32),
+            internal_weight=pad(tree.internal_weight, np.float32),
+            internal_count=pad(tree.internal_count, np.float32),
+            leaf_value=padl(tree.leaf_value, np.float32),
+            leaf_weight=padl(tree.leaf_weight, np.float32),
+            leaf_count=padl(tree.leaf_count, np.float32),
+            leaf_parent=padl(tree.leaf_parent, np.int32),
+            leaf_depth=padl(tree.leaf_depth, np.int32),
+            num_leaves=jnp.int32(nl), row_leaf=jnp.zeros((0,), dtype=jnp.int32))
+
+    def _add_tree_score_train(self, tree: Tree, class_id: int,
+                              arrays: Optional[TreeArrays] = None) -> None:
+        """train_score += tree(train rows); uses cached row_leaf when available."""
+        if arrays is not None and arrays.row_leaf.shape[0] > 0:
+            dev = arrays
+            leaf = dev.row_leaf
+        else:
+            dev = self._tree_to_device(tree)
+            leaf = route_binned(self.learner.bins, dev, self.learner.feat,
+                                num_leaves=int(self.config.num_leaves))
+        vals = jnp.asarray(
+            np.concatenate([tree.leaf_value[:tree.num_leaves],
+                            np.zeros(max(dev.leaf_value.shape[0]
+                                         - tree.num_leaves, 0))]).astype(np.float32))
+        self.train_score = self.train_score.at[class_id].add(vals[leaf])
+
+    def _add_tree_score_valid(self, model_idx: int, tree: Tree, class_id: int,
+                              vs: dict) -> None:
+        dev = self._tree_to_device(tree)
+        leaf = route_binned(vs["bins"], dev, self.learner.feat,
+                            num_leaves=int(self.config.num_leaves))
+        vals = jnp.asarray(
+            np.concatenate([tree.leaf_value[:tree.num_leaves],
+                            np.zeros(max(dev.leaf_value.shape[0]
+                                         - tree.num_leaves, 0))]).astype(np.float32))
+        vs["score"] = vs["score"].at[class_id].add(vals[leaf])
+
+    def _add_constant_score(self, value: float, class_id: int) -> None:
+        self.train_score = self.train_score.at[class_id].add(value)
+        for vs in self.valid_sets:
+            vs["score"] = vs["score"].at[class_id].add(value)
+
+    # ---- bagging (gbdt.cpp:160-276) ----
+
+    def _bagging(self, it: int) -> None:
+        cfg = self.config
+        if (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+                and it % cfg.bagging_freq == 0):
+            n = self.num_data
+            cnt = max(1, int(n * cfg.bagging_fraction))
+            idx = self._bag_rng.choice(n, size=cnt, replace=False)
+            mask = np.zeros(n, dtype=np.float32)
+            mask[idx] = 1.0
+            self.bag_mask = self.learner.pad_rows(jnp.asarray(mask))
+            self.bag_data_cnt = cnt
+        elif self.bag_mask is None:
+            self.bag_data_cnt = self.num_data
+
+    def _feature_mask(self) -> Optional[jnp.ndarray]:
+        ff = float(self.config.feature_fraction)
+        nf = self.train_data.num_features
+        if ff >= 1.0 or nf <= 1:
+            return None
+        used = max(1, int(round(nf * ff)))
+        chosen = self._feat_rng.choice(nf, size=used, replace=False)
+        mask = np.zeros(nf, dtype=bool)
+        mask[chosen] = True
+        return jnp.asarray(mask)
+
+    # ---- boosting (gbdt.cpp:143-158, 322-368) ----
+
+    def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
+        if (not self.models and not self._has_init_score
+                and self.objective is not None):
+            if self.config.boost_from_average or self.train_data.num_features == 0:
+                init_score = self.objective.boost_from_score(class_id)
+                if abs(init_score) > K_EPSILON:
+                    if update_scorer:
+                        self._add_constant_score(init_score, class_id)
+                    Log.info("Start training from score %f", init_score)
+                    return init_score
+            elif self.objective.name in ("regression_l1", "quantile", "mape"):
+                Log.warning("Disabling boost_from_average in %s may cause the "
+                            "slow convergence", self.objective.name)
+        return 0.0
+
+    def _get_gradients(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        score = self.train_score[:, :self.num_data]
+        if self.num_tree_per_iteration == 1:
+            g, h = self.objective.get_gradients(score[0])
+            return g[None, :], h[None, :]
+        return self.objective.get_gradients(score)
+
+    def get_training_score(self) -> jnp.ndarray:
+        """Scores used for gradient computation this iteration (DART overrides)."""
+        return self.train_score
+
+    # ---- the iteration ----
+
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """Returns True when training cannot continue (no splittable leaves)."""
+        init_scores = [0.0] * self.num_tree_per_iteration
+        if gradients is None or hessians is None:
+            for k in range(self.num_tree_per_iteration):
+                init_scores[k] = self._boost_from_average(k, True)
+            with FunctionTimer("GBDT::Boosting"):
+                grad, hess = self._get_gradients()
+        else:
+            grad = jnp.asarray(np.asarray(gradients, dtype=np.float32)).reshape(
+                self.num_tree_per_iteration, self.num_data)
+            hess = jnp.asarray(np.asarray(hessians, dtype=np.float32)).reshape(
+                self.num_tree_per_iteration, self.num_data)
+
+        with FunctionTimer("GBDT::Bagging"):
+            self._bagging(self.iter_)
+            grad, hess = self._adjust_gradients_for_bagging(grad, hess)
+
+        should_continue = False
+        self._last_iter_arrays = []
+        feature_mask = self._feature_mask()
+        for k in range(self.num_tree_per_iteration):
+            new_tree = Tree(1)
+            arrays = None
+            if self.class_need_train[k] and self.train_data.num_features > 0:
+                gk = self.learner.pad_rows(grad[k])
+                hk = self.learner.pad_rows(hess[k])
+                if self.bag_mask is not None:
+                    gk = gk * self.bag_mask
+                    hk = hk * self.bag_mask
+                with FunctionTimer("TreeLearner::Train"):
+                    arrays = self.learner.train(gk, hk, self.bag_data_cnt,
+                                                feature_mask)
+                nl = int(arrays.num_leaves)
+                if nl > 1:
+                    new_tree = self.learner.host_tree(arrays)
+
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                arrays = self._renew_tree_output(new_tree, arrays, k)
+                new_tree.shrink(self.shrinkage_rate)
+                scaled = arrays._replace(
+                    leaf_value=arrays.leaf_value * self.shrinkage_rate)
+                with FunctionTimer("GBDT::UpdateScore"):
+                    self.train_score = self.train_score.at[k].add(
+                        self._gather_tree_output(scaled))
+                    for vs in self.valid_sets:
+                        self._add_tree_score_valid(len(self.models), new_tree, k,
+                                                   vs)
+                if abs(init_scores[k]) > K_EPSILON:
+                    new_tree.add_bias(init_scores[k])
+                self._last_iter_arrays.append(scaled)
+            else:
+                if len(self.models) < self.num_tree_per_iteration:
+                    if not self.class_need_train[k] and self.objective is not None:
+                        output = self.objective.boost_from_score(k)
+                    else:
+                        output = init_scores[k]
+                    new_tree = Tree(1)
+                    new_tree.leaf_value[0] = output
+                    if abs(output) > K_EPSILON:
+                        self._add_constant_score(output, k)
+                self._last_iter_arrays.append(None)
+            self.models.append(new_tree)
+
+        if not should_continue:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            return True
+        self.iter_ += 1
+        return False
+
+    def _adjust_gradients_for_bagging(self, grad, hess):
+        return grad, hess
+
+    def _renew_tree_output(self, tree: Tree, arrays: TreeArrays,
+                           class_id: int) -> TreeArrays:
+        """Per-leaf output renewal for percentile objectives
+        (serial_tree_learner.cpp:706-744 RenewTreeOutput)."""
+        if self.objective is None or not self.objective.is_renew_tree_output:
+            return arrays
+        row_leaf = np.asarray(arrays.row_leaf)[:self.num_data]
+        score = np.asarray(self.train_score[class_id, :self.num_data])
+        label = self.objective.label_np
+        residual = label - score
+        if self.objective.name == "mape":
+            weights = self.objective.label_weight_np
+        else:
+            weights = self.objective.weights_np
+        bag = (np.asarray(self.bag_mask)[:self.num_data] > 0
+               if self.bag_mask is not None else None)
+        new_vals = tree.leaf_value.copy()
+        for leaf in range(tree.num_leaves):
+            rows = row_leaf == leaf
+            if bag is not None:
+                rows = rows & bag
+            if not rows.any():
+                continue
+            w = None if weights is None else weights[rows]
+            new_vals[leaf] = self.objective.renew_tree_output(residual[rows], w)
+        tree.leaf_value[:] = new_vals
+        return arrays._replace(leaf_value=jnp.asarray(
+            np.concatenate([new_vals[:tree.num_leaves],
+                            np.zeros(arrays.leaf_value.shape[0]
+                                     - tree.num_leaves)]).astype(np.float32)))
+
+    def rollback_one_iter(self) -> None:
+        """Undo the last iteration (gbdt.cpp:454-470)."""
+        if self.iter_ <= 0:
+            return
+        for k in range(self.num_tree_per_iteration):
+            idx = len(self.models) - self.num_tree_per_iteration + k
+            tree = self.models[idx]
+            tree.shrink(-1.0)
+            arrays = (self._last_iter_arrays[k]
+                      if k < len(self._last_iter_arrays) else None)
+            if arrays is not None:
+                self.train_score = self.train_score.at[k].add(
+                    -self._gather_tree_output(arrays))
+            for vs in self.valid_sets:
+                self._add_tree_score_valid(idx, tree, k, vs)
+        del self.models[-self.num_tree_per_iteration:]
+        self.iter_ -= 1
+
+    # ---- training driver with internal early stopping (CLI path) ----
+
+    def train(self, snapshot_out: Optional[str] = None) -> None:
+        for it in range(self.iter_, int(self.config.num_iterations)):
+            finished = self.train_one_iter()
+            if not finished and self.config.metric_freq > 0 \
+                    and it % self.config.metric_freq == 0:
+                finished = self.eval_and_check_early_stopping()
+            if finished:
+                break
+            if (snapshot_out and self.config.snapshot_freq > 0
+                    and (it + 1) % self.config.snapshot_freq == 0):
+                path = "%s.snapshot_iter_%d" % (snapshot_out, it + 1)
+                self.save_model(path)
+
+    # ---- evaluation ----
+
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        score = np.asarray(self.get_training_score()[:, :self.num_data])
+        for m in self.train_metrics:
+            for name, val in zip(m.names, m.eval(score, self.objective)):
+                out.append(("training", name, val, m.factor_to_bigger_better > 0))
+        return out
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for vs in self.valid_sets:
+            score = np.asarray(vs["score"])
+            for m in vs["metrics"]:
+                for name, val in zip(m.names, m.eval(score, self.objective)):
+                    out.append((vs["name"], name, val,
+                                m.factor_to_bigger_better > 0))
+        return out
+
+    def eval_and_check_early_stopping(self) -> bool:
+        for ds, name, val, _ in self.eval_train():
+            Log.info("Iteration:%d, %s %s : %g", self.iter_, ds, name, val)
+        stop = False
+        rounds = int(self.config.early_stopping_round)
+        for ds, name, val, bigger_better in self.eval_valid():
+            Log.info("Iteration:%d, valid_1 %s : %g", self.iter_, name, val)
+            if rounds > 0:
+                key = (ds, name)
+                cur = val if bigger_better else -val
+                best = self._es_state.get(key)
+                if best is None or cur > best[0]:
+                    self._es_state[key] = (cur, self.iter_)
+                elif self.iter_ - best[1] >= rounds:
+                    Log.info("Early stopping at iteration %d, the best iteration "
+                             "round is %d", self.iter_, best[1])
+                    stop = True
+        return stop
+
+    # ---- prediction (host path; gbdt_prediction.cpp) ----
+
+    def _raw_predict(self, X: np.ndarray, num_iteration: int = -1,
+                     start_iteration: int = 0) -> np.ndarray:
+        n = len(X)
+        K = self.num_tree_per_iteration
+        out = np.zeros((K, n), dtype=np.float64)
+        total_iter = len(self.models) // K
+        end_iter = total_iter if num_iteration <= 0 else min(
+            total_iter, start_iteration + num_iteration)
+        for i in range(start_iteration * K, end_iter * K):
+            out[i % K] += self.models[i].predict(X)
+        return out
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                num_iteration: int = -1, start_iteration: int = 0) -> np.ndarray:
+        raw = self._raw_predict(X, num_iteration, start_iteration)
+        if self.average_output:
+            total_iter = max(len(self.models) // self.num_tree_per_iteration, 1)
+            raw = raw / total_iter
+        if not raw_score and self.objective is not None:
+            raw = np.asarray(self.objective.convert_output(raw))
+        return raw[0] if self.num_tree_per_iteration == 1 else raw.T
+
+    def predict_leaf_index(self, X: np.ndarray,
+                           num_iteration: int = -1) -> np.ndarray:
+        K = self.num_tree_per_iteration
+        total_iter = len(self.models) // K
+        end = total_iter if num_iteration <= 0 else min(total_iter, num_iteration)
+        cols = [self.models[i].predict_leaf_index(X) for i in range(end * K)]
+        return np.stack(cols, axis=1) if cols else np.zeros((len(X), 0), np.int32)
+
+    # ---- feature importance (c_api.cpp:1573 semantics) ----
+
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = -1) -> np.ndarray:
+        K = self.num_tree_per_iteration
+        total_iter = len(self.models) // K
+        end = total_iter if num_iteration <= 0 else min(total_iter, num_iteration)
+        out = np.zeros(self.max_feature_idx + 1, dtype=np.float64)
+        for i in range(end * K):
+            t = self.models[i]
+            if importance_type == "split":
+                for f in t.splits_by_feature():
+                    out[f] += 1
+            else:
+                feats, gains = t.gains_by_feature()
+                for f, g in zip(feats, gains):
+                    out[f] += g
+        return out
+
+    # ---- model serialization (gbdt_model_text.cpp:271,375) ----
+
+    def sub_model_name(self) -> str:
+        return "tree"
+
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1) -> str:
+        lines = [self.sub_model_name(), "version=%s" % MODEL_VERSION,
+                 "num_class=%d" % self.num_class,
+                 "num_tree_per_iteration=%d" % self.num_tree_per_iteration,
+                 "label_index=%d" % self.label_idx,
+                 "max_feature_idx=%d" % self.max_feature_idx]
+        if self.objective is not None:
+            lines.append("objective=%s" % self.objective.to_string())
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(self.feature_names))
+        lines.append("feature_infos=" + " ".join(self.feature_infos))
+
+        K = self.num_tree_per_iteration
+        total_iter = len(self.models) // K
+        start_iteration = min(max(start_iteration, 0), total_iter)
+        num_used = total_iter * K
+        if num_iteration > 0:
+            num_used = min((start_iteration + num_iteration) * K, num_used)
+        start_model = start_iteration * K
+        tree_strs = []
+        for i in range(start_model, num_used):
+            tree_strs.append("Tree=%d\n" % (i - start_model)
+                             + self.models[i].to_string() + "\n")
+        lines.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+        lines.append("")
+        body = "\n".join(lines) + "\n" + "".join(tree_strs) + "end of trees\n"
+
+        imps = self.feature_importance("split", num_iteration)
+        pairs = sorted([(int(v), self.feature_names[i])
+                        for i, v in enumerate(imps) if v > 0],
+                       key=lambda p: -p[0])
+        body += "\nfeature importances:\n"
+        body += "".join("%s=%d\n" % (nm, v) for v, nm in pairs)
+        body += "\nparameters:\n"
+        for k, v in sorted(self.config.raw_params.items()):
+            body += "[%s: %s]\n" % (k, v)
+        body += "end of parameters\n"
+        return body
+
+    def save_model(self, filename: str, start_iteration: int = 0,
+                   num_iteration: int = -1) -> None:
+        with open(filename, "w") as fh:
+            fh.write(self.save_model_to_string(start_iteration, num_iteration))
+        Log.info("Finished writing model to file %s", filename)
+
+    def load_model_from_string(self, text: str) -> None:
+        split_at = text.find("\nTree=")
+        header = text[:split_at] if split_at >= 0 else text
+        rest = text[split_at + 1:] if split_at >= 0 else ""
+        kv: Dict[str, str] = {}
+        for line in header.splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        self.num_class = int(kv.get("num_class", 1))
+        self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
+        self.label_idx = int(kv.get("label_index", 0))
+        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos = kv.get("feature_infos", "").split()
+        self.average_output = "average_output" in header.splitlines()
+        if "objective" in kv and self.objective is None:
+            obj_str = kv["objective"].split()
+            cfg = self.config
+            if self.num_class > 1:
+                cfg.num_class = self.num_class
+            self.objective = create_objective(obj_str[0], cfg)
+        self.models = []
+        if rest:
+            trees_text = rest.split("end of trees")[0]
+            for block in trees_text.split("Tree="):
+                block = block.strip()
+                if not block:
+                    continue
+                block = block.split("\n", 1)[1] if "\n" in block else ""
+                if block.strip():
+                    self.models.append(Tree.from_string(block))
+        self.num_init_iteration = len(self.models) // max(
+            self.num_tree_per_iteration, 1)
+        self.iter_ = 0
+
+    @classmethod
+    def load_model(cls, filename: str, config: Optional[Config] = None) -> "GBDT":
+        with open(filename) as fh:
+            text = fh.read()
+        config = config or Config()
+        first = text.splitlines()[0].strip() if text else ""
+        booster = {"tree": cls}.get(first, cls)(config)
+        booster.load_model_from_string(text)
+        return booster
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)

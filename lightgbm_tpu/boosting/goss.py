@@ -1,0 +1,60 @@
+"""GOSS: gradient-based one-side sampling (src/boosting/goss.hpp:25-185).
+
+Keep the top_rate fraction by |grad*hess|, sample other_rate from the rest and
+amplify their grad/hess by (1-top_rate)/other_rate.  Expressed as a row weight
+mask (0 / 1 / multiplier) folded into grad/hess, matching the reference's
+in-place gradient scaling (goss.hpp:117-121).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gbdt import GBDT
+from ..utils.log import Log
+
+
+class GOSS(GBDT):
+    def __init__(self, config, train_data=None, objective=None):
+        super().__init__(config, train_data, objective)
+        if config.top_rate + config.other_rate > 1.0:
+            Log.fatal("top_rate + other_rate cannot be larger than 1.0 in GOSS")
+        if config.top_rate <= 0.0 or config.other_rate <= 0.0:
+            Log.fatal("top_rate and other_rate must be positive in GOSS")
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            Log.fatal("Cannot use bagging in GOSS")
+        Log.info("Using GOSS")
+        self._goss_multiplier = None
+
+    def _bagging(self, it: int) -> None:
+        # GOSS resamples every iteration once warmed up (goss.hpp:133-136:
+        # no subsampling for the first 1/learning_rate iterations)
+        self.bag_mask = None
+        self.bag_data_cnt = self.num_data
+        self._goss_multiplier = None
+        if it < int(1.0 / self.config.learning_rate):
+            return
+        self._needs_goss = True
+
+    def _adjust_gradients_for_bagging(self, grad, hess):
+        if getattr(self, "_needs_goss", False):
+            self._needs_goss = False
+            g = np.asarray(jnp.abs(grad * hess).sum(axis=0))
+            n = self.num_data
+            top_k = max(1, int(n * self.config.top_rate))
+            other_k = max(1, int(n * self.config.other_rate))
+            order = np.argsort(-g, kind="stable")
+            top_idx = order[:top_k]
+            rest = order[top_k:]
+            sampled = self._bag_rng.choice(
+                len(rest), size=min(other_k, len(rest)), replace=False)
+            other_idx = rest[sampled]
+            multiply = (n - top_k) / max(other_k, 1)
+            w = np.zeros(n, dtype=np.float32)
+            w[top_idx] = 1.0
+            w[other_idx] = multiply
+            self.bag_data_cnt = top_k + len(other_idx)
+            self.bag_mask = None  # weights are folded into grad/hess below
+            wj = jnp.asarray(w)[None, :]
+            return grad * wj, hess * wj
+        return grad, hess

@@ -1,0 +1,88 @@
+"""Multiclass metrics (src/metric/multiclass_metric.hpp) and AUC-mu."""
+from __future__ import annotations
+
+import numpy as np
+
+from .binary import weighted_auc
+from .metric import Metric
+
+
+class _MulticlassMetric(Metric):
+    metric_name = ""
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self.names = [self.metric_name]
+        self.num_class = int(self.config.num_class)
+        self.label_int = self.label.astype(np.int64)
+
+    def point_loss(self, label_int, prob):
+        raise NotImplementedError
+
+    def eval(self, score, objective=None):
+        s = np.asarray(score, dtype=np.float64).reshape(self.num_class, -1)
+        if objective is not None:
+            prob = np.asarray(objective.convert_output(s))
+        else:
+            e = np.exp(s - s.max(axis=0, keepdims=True))
+            prob = e / e.sum(axis=0, keepdims=True)
+        return [self._avg(self.point_loss(self.label_int, prob))]
+
+
+class MultiSoftmaxLoglossMetric(_MulticlassMetric):
+    metric_name = "multi_logloss"
+
+    def point_loss(self, label_int, prob):
+        p_true = prob[label_int, np.arange(len(label_int))]
+        return -np.log(np.maximum(p_true, 1e-15))
+
+
+class MultiErrorMetric(_MulticlassMetric):
+    metric_name = "multi_error"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        k = int(getattr(self.config, "multi_error_top_k", 1))
+        self.top_k = max(k, 1)
+        if self.top_k > 1:
+            self.names = ["multi_error@%d" % self.top_k]
+
+    def point_loss(self, label_int, prob):
+        # error when the true class is not within top-k scores
+        # (multiclass_metric.hpp top-k rule: count of classes with prob strictly
+        #  greater than the true class's must be < k)
+        p_true = prob[label_int, np.arange(len(label_int))]
+        rank = (prob > p_true[None, :]).sum(axis=0)
+        return (rank >= self.top_k).astype(np.float64)
+
+
+class AucMuMetric(Metric):
+    """AUC-mu: average pairwise class separability
+    (multiclass extension of AUC; src/metric/multiclass_metric.hpp AucMuMetric).
+
+    The reference ranks class-i-vs-class-j samples by the weighted score
+    difference a^T(p_i - p_j); with default (all-ones off-diagonal) weights this
+    reduces to ranking by score_i - score_j, which is what we compute."""
+    factor_to_bigger_better = 1.0
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self.names = ["auc_mu"]
+        self.num_class = int(self.config.num_class)
+        self.label_int = self.label.astype(np.int64)
+
+    def eval(self, score, objective=None):
+        s = np.asarray(score, dtype=np.float64).reshape(self.num_class, -1)
+        k = self.num_class
+        aucs = []
+        for i in range(k):
+            for j in range(i + 1, k):
+                sel = (self.label_int == i) | (self.label_int == j)
+                if not sel.any():
+                    aucs.append(1.0)
+                    continue
+                y = (self.label_int[sel] == i).astype(np.float64)
+                diff = s[i, sel] - s[j, sel]
+                w = None if self.weights is None else self.weights[sel]
+                aucs.append(weighted_auc(y, diff, w))
+        return [float(np.mean(aucs))]

@@ -1,0 +1,70 @@
+"""Objective function interface.
+
+Counterpart of the reference ``ObjectiveFunction`` (include/LightGBM/
+objective_function.h): gradients/hessians from scores, boost-from-score,
+raw-score -> output conversion, and optional per-leaf output renewal.
+
+Elementwise objectives compute gradients on device (jitted jnp); the listwise
+ranking objectives run per-query on host NumPy (their pairwise loops are not a
+device-friendly hot spot at reference scale).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.metadata import Metadata
+
+
+class ObjectiveFunction:
+    name: str = "custom"
+    num_model_per_iteration: int = 1
+    is_constant_hessian: bool = False
+    need_accurate_prediction: bool = True
+    is_renew_tree_output: bool = False
+
+    def __init__(self, config) -> None:
+        self.config = config
+        self.num_data = 0
+        self.label: Optional[jnp.ndarray] = None
+        self.weights: Optional[jnp.ndarray] = None
+        self.label_np: Optional[np.ndarray] = None
+        self.weights_np: Optional[np.ndarray] = None
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label_np = np.asarray(metadata.label, dtype=np.float32)
+        self.label = jnp.asarray(self.label_np)
+        if metadata.weights is not None:
+            self.weights_np = np.asarray(metadata.weights, dtype=np.float32)
+            self.weights = jnp.asarray(self.weights_np)
+        else:
+            self.weights_np = None
+            self.weights = None
+        self.metadata = metadata
+
+    def get_gradients(self, score) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """score: [num_model_per_iteration, N] (or [N]) raw scores -> (grad, hess)
+        of the same shape."""
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return 0.0
+
+    def convert_output(self, scores: np.ndarray) -> np.ndarray:
+        """Raw score -> prediction output (identity by default)."""
+        return scores
+
+    def renew_tree_output(self, leaf_rows_residual, leaf_rows_weight) -> float:
+        """New output for one leaf given its rows' residuals (+weights)."""
+        raise NotImplementedError
+
+    def _apply_weights(self, grad, hess):
+        if self.weights is not None:
+            return grad * self.weights, hess * self.weights
+        return grad, hess
+
+    def to_string(self) -> str:
+        return self.name

@@ -1,0 +1,170 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.metric.metric import create_metrics
+from lightgbm_tpu.objective import create_objective
+
+
+def make_regression(n=600, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2, 2, size=(n, 5))
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2) + 0.5 * X[:, 2] * X[:, 3]
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+def make_binary(n=800, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2, 2, size=(n, 6))
+    logit = X[:, 0] * 1.5 - X[:, 1] + 0.8 * X[:, 2] * X[:, 0]
+    y = (logit + rng.logistic(size=n) * 0.5 > 0).astype(np.float32)
+    return X, y
+
+
+def fit(X, y, params, n_iter=30, Xv=None, yv=None):
+    cfg = Config(params)
+    ds = BinnedDataset.from_matrix(
+        X, label=y, max_bin=cfg.max_bin,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        categorical_feature=cfg.categorical_feature or [])
+    obj = create_objective(cfg.objective, cfg)
+    booster = create_boosting(cfg.boosting, cfg, ds, obj)
+    booster.add_train_metrics(create_metrics(cfg.metric, cfg))
+    if Xv is not None:
+        vs = BinnedDataset.from_matrix(Xv, label=yv, reference=ds)
+        booster.add_valid_data(vs, "valid_0")
+    for _ in range(n_iter):
+        if booster.train_one_iter():
+            break
+    return booster, ds
+
+
+def test_regression_l2_converges():
+    X, y = make_regression()
+    booster, ds = fit(X, y, {"objective": "regression", "num_leaves": 31,
+                             "learning_rate": 0.1, "min_data_in_leaf": 5})
+    (_, name, mse, _), = booster.eval_train()
+    assert name == "l2"
+    base = np.var(y)
+    assert mse < 0.25 * base
+    # host prediction path agrees with the training-score path
+    pred = booster.predict(X)
+    train_mse = float(np.mean((pred - y) ** 2))
+    assert train_mse == pytest.approx(mse, rel=1e-3, abs=1e-5)
+
+
+def test_boost_from_average():
+    X, y = make_regression()
+    y = y + 100.0  # large offset: boost_from_average must absorb it
+    booster, _ = fit(X, y, {"objective": "regression"}, n_iter=3)
+    pred = booster.predict(X)
+    assert abs(pred.mean() - y.mean()) < 1.0
+
+
+def test_binary_auc_improves():
+    X, y = make_binary()
+    Xv, yv = make_binary(seed=7)
+    booster, _ = fit(X, y, {"objective": "binary", "metric": "auc,binary_logloss",
+                            "num_leaves": 15, "min_data_in_leaf": 5},
+                     n_iter=30, Xv=Xv, yv=yv)
+    res = booster.eval_valid()
+    auc = [v for (_, n, v, _) in res if n == "auc"][0]
+    assert auc > 0.9
+    # predictions are probabilities
+    p = booster.predict(Xv)
+    assert p.min() >= 0 and p.max() <= 1
+
+
+def test_multiclass_softmax():
+    rng = np.random.RandomState(3)
+    X = rng.uniform(-2, 2, size=(900, 4))
+    y = (np.argmax(np.stack([X[:, 0], X[:, 1], X[:, 2]]), axis=0)).astype(np.float32)
+    booster, _ = fit(X, y, {"objective": "multiclass", "num_class": 3,
+                            "num_leaves": 15, "min_data_in_leaf": 5}, n_iter=25)
+    pred = booster.predict(X)
+    assert pred.shape == (900, 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, atol=1e-5)
+    acc = (np.argmax(pred, axis=1) == y).mean()
+    assert acc > 0.85
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    X, y = make_binary()
+    booster, _ = fit(X, y, {"objective": "binary", "num_leaves": 7}, n_iter=10)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = GBDT.load_model(path)
+    np.testing.assert_allclose(loaded.predict(X, raw_score=True),
+                               booster.predict(X, raw_score=True),
+                               rtol=1e-5, atol=1e-6)
+    assert loaded.objective.name == "binary"
+
+
+def test_bagging_and_feature_fraction():
+    X, y = make_regression(seed=4)
+    booster, _ = fit(X, y, {"objective": "regression", "bagging_fraction": 0.5,
+                            "bagging_freq": 1, "feature_fraction": 0.6,
+                            "min_data_in_leaf": 5}, n_iter=20)
+    (_, _, mse, _), = booster.eval_train()
+    assert mse < 0.5 * np.var(y)
+
+
+def test_l1_renews_leaf_outputs():
+    X, y = make_regression(seed=5)
+    booster, _ = fit(X, y, {"objective": "regression_l1", "metric": "l1",
+                            "min_data_in_leaf": 5}, n_iter=25)
+    (_, name, l1, _), = booster.eval_train()
+    assert name == "l1"
+    assert l1 < 0.5 * np.mean(np.abs(y - np.median(y)))
+
+
+def test_dart_smoke():
+    X, y = make_regression(seed=6)
+    booster, _ = fit(X, y, {"objective": "regression", "boosting": "dart",
+                            "drop_rate": 0.3, "min_data_in_leaf": 5}, n_iter=15)
+    (_, _, mse, _), = booster.eval_train()
+    assert mse < np.var(y)
+
+
+def test_goss_smoke():
+    X, y = make_regression(seed=7)
+    booster, _ = fit(X, y, {"objective": "regression", "boosting": "goss",
+                            "learning_rate": 0.2, "min_data_in_leaf": 5},
+                     n_iter=20)
+    (_, _, mse, _), = booster.eval_train()
+    assert mse < 0.5 * np.var(y)
+
+
+def test_rf_smoke():
+    X, y = make_binary(seed=8)
+    booster, _ = fit(X, y, {"objective": "binary", "boosting": "rf",
+                            "bagging_fraction": 0.6, "bagging_freq": 1,
+                            "feature_fraction": 0.8, "min_data_in_leaf": 5},
+                     n_iter=10)
+    p = booster.predict(X)
+    acc = ((p > 0.5) == y).mean()
+    assert acc > 0.8
+
+
+def test_rollback_one_iter():
+    X, y = make_regression(seed=9)
+    booster, _ = fit(X, y, {"objective": "regression"}, n_iter=5)
+    (_, _, mse5, _), = booster.eval_train()
+    booster.rollback_one_iter()
+    assert booster.num_trees == 4
+    (_, _, mse4, _), = booster.eval_train()
+    assert mse4 > mse5
+
+
+def test_continued_training(tmp_path):
+    X, y = make_regression(seed=10)
+    booster, ds = fit(X, y, {"objective": "regression"}, n_iter=10)
+    (_, _, mse10, _), = booster.eval_train()
+    for _ in range(10):
+        booster.train_one_iter()
+    (_, _, mse20, _), = booster.eval_train()
+    assert mse20 < mse10
