@@ -29,6 +29,7 @@ from ..config import Config
 from ..core.tree import Tree
 from ..core.tree_learner import (SerialTreeLearner, TreeArrays, route_binned,
                                  tree_from_arrays)
+from ..parallel import create_tree_learner
 from ..io.dataset import BinnedDataset
 from ..metric.metric import Metric, create_metrics
 from ..objective import ObjectiveFunction, create_objective
@@ -75,7 +76,7 @@ class GBDT:
         self.num_data = train_data.num_data
         self.num_tree_per_iteration = (objective.num_model_per_iteration
                                        if objective else max(1, self.num_class))
-        self.learner = SerialTreeLearner(train_data, self.config)
+        self.learner = create_tree_learner(train_data, self.config)
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
         self.feature_infos = train_data.feature_infos()

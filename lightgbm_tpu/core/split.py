@@ -73,6 +73,25 @@ class BestSplit(NamedTuple):
     right_output: jax.Array
 
 
+class FeatureBest(NamedTuple):
+    """Best split of every feature (all [F] arrays) — the device analogue of the
+    per-feature ``SplitInfo`` array the reference keeps per leaf
+    (serial_tree_learner.cpp:399 best_split_per_leaf_); exposing it lets the
+    parallel learners shard the scan (data_parallel_tree_learner.cpp:167) and vote
+    on top-k features (voting_parallel_tree_learner.cpp:170)."""
+    gain: jax.Array
+    threshold: jax.Array
+    default_left: jax.Array
+    left_sum_grad: jax.Array
+    left_sum_hess: jax.Array
+    left_count: jax.Array
+    right_sum_grad: jax.Array
+    right_sum_hess: jax.Array
+    right_count: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+
+
 def threshold_l1(s, l1):
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 
@@ -102,11 +121,10 @@ def _split_gains(gl, hl, gr, hr, p: SplitParams):
     return gain, lo, ro
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def best_split_numerical(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array,
-                         sum_grad: jax.Array, sum_hess: jax.Array,
-                         num_data: jax.Array, params: SplitParams) -> BestSplit:
-    """Best numerical split over all features of one leaf.
+def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array,
+                     sum_grad: jax.Array, sum_hess: jax.Array,
+                     num_data: jax.Array, params: SplitParams) -> FeatureBest:
+    """Best numerical split of EACH feature of one leaf (all outputs [F]).
 
     hist: [F, 2, B] f32; feature_mask: [F] bool (feature_fraction);
     sum_grad/sum_hess/num_data: leaf totals (scalars).
@@ -212,28 +230,64 @@ def best_split_numerical(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.A
     two_bin_nan = (mt[:, 0] == int(MissingType.NAN)) & (feat.num_bin <= 2)
     feat_default_left = ~use1 & ~two_bin_nan
 
-    best_f = jnp.argmax(feat_gain).astype(jnp.int32)      # smallest feature wins ties
-    best_gain = feat_gain[best_f]
-    best_t = feat_thr[best_f]
-    dl = feat_default_left[best_f]
-    u1 = use1[best_f]
+    fidx = jnp.arange(F)
 
     def pick(arr0, arr1):
-        return jnp.where(u1, arr1[best_f, best_t], arr0[best_f, best_t])
+        return jnp.where(use1, arr1[fidx, feat_thr], arr0[fidx, feat_thr])
 
-    l_g, l_h, l_c = pick(left_g0, left_g1), pick(left_h0, left_h1), pick(left_c0, left_c1)
-    r_g, r_h, r_c = (pick(right_g0, right_g1), pick(right_h0, right_h1),
-                     pick(right_c0, right_c1))
-    l_out = jnp.where(u1, lo1[best_f, best_t], lo0[best_f, best_t])
-    r_out = jnp.where(u1, ro1[best_f, best_t], ro0[best_f, best_t])
-
-    found = best_gain > K_MIN_SCORE
-    return BestSplit(
-        gain=jnp.where(found, best_gain - min_gain_shift, K_MIN_SCORE),
-        feature=best_f,
-        threshold=best_t,
-        default_left=dl,
-        left_sum_grad=l_g, left_sum_hess=l_h - K_EPSILON, left_count=l_c,
-        right_sum_grad=r_g, right_sum_hess=r_h - K_EPSILON, right_count=r_c,
-        left_output=l_out, right_output=r_out,
+    found = feat_gain > K_MIN_SCORE
+    return FeatureBest(
+        gain=jnp.where(found, feat_gain - min_gain_shift, K_MIN_SCORE),
+        threshold=feat_thr,
+        default_left=feat_default_left,
+        left_sum_grad=pick(left_g0, left_g1),
+        left_sum_hess=pick(left_h0, left_h1) - K_EPSILON,
+        left_count=pick(left_c0, left_c1),
+        right_sum_grad=pick(right_g0, right_g1),
+        right_sum_hess=pick(right_h0, right_h1) - K_EPSILON,
+        right_count=pick(right_c0, right_c1),
+        left_output=jnp.where(use1, lo1[fidx, feat_thr], lo0[fidx, feat_thr]),
+        right_output=jnp.where(use1, ro1[fidx, feat_thr], ro0[fidx, feat_thr]),
     )
+
+
+def reduce_feature_best(fb: FeatureBest, feature_ids: jax.Array) -> BestSplit:
+    """Argmax-by-gain across features; ties go to the smaller feature id
+    (split_info.hpp:185 comparators).  ``feature_ids`` maps positions in ``fb`` to
+    global inner-feature indices (they must be ascending for the tie-break)."""
+    best_f = jnp.argmax(fb.gain).astype(jnp.int32)   # first max = smallest id
+    return BestSplit(
+        gain=fb.gain[best_f],
+        feature=feature_ids[best_f].astype(jnp.int32),
+        threshold=fb.threshold[best_f],
+        default_left=fb.default_left[best_f],
+        left_sum_grad=fb.left_sum_grad[best_f],
+        left_sum_hess=fb.left_sum_hess[best_f],
+        left_count=fb.left_count[best_f],
+        right_sum_grad=fb.right_sum_grad[best_f],
+        right_sum_hess=fb.right_sum_hess[best_f],
+        right_count=fb.right_count[best_f],
+        left_output=fb.left_output[best_f],
+        right_output=fb.right_output[best_f],
+    )
+
+
+def sync_best(best: BestSplit, axis_name: str) -> BestSplit:
+    """Allreduce-argmax of per-shard best splits across a mesh axis — the XLA
+    equivalent of ``SyncUpGlobalBestSplit`` (parallel_tree_learner.h:190-213):
+    all_gather the candidates and pick max gain, ties to the smaller feature id."""
+    g = BestSplit(*[jax.lax.all_gather(x, axis_name) for x in best])  # [d] each
+    max_gain = jnp.max(g.gain)
+    tie_feat = jnp.where(g.gain == max_gain, g.feature, jnp.int32(2**31 - 1))
+    i = jnp.argmin(tie_feat)
+    return BestSplit(*[x[i] for x in g])
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def best_split_numerical(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array,
+                         sum_grad: jax.Array, sum_hess: jax.Array,
+                         num_data: jax.Array, params: SplitParams) -> BestSplit:
+    """Best numerical split over all features of one leaf (scalars out)."""
+    fb = per_feature_best(hist, feat, feature_mask, sum_grad, sum_hess,
+                          num_data, params)
+    return reduce_feature_best(fb, jnp.arange(hist.shape[0], dtype=jnp.int32))

@@ -33,10 +33,38 @@ import numpy as np
 
 from .histogram import build_histogram, _pad_bins
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
+                    per_feature_best, reduce_feature_best, sync_best,
                     K_MIN_SCORE)
 from .tree import Tree
 from ..io.binning import BinType, MissingType
 from ..io.dataset import BinnedDataset
+
+
+class Comm(NamedTuple):
+    """Static collective-communication strategy for multi-chip tree growth.
+
+    Replaces the reference's ``Network`` singleton calls (SURVEY.md §2.3) with XLA
+    collectives inside the compiled tree build; ``build_tree`` is run under
+    ``jax.shard_map`` over a mesh axis named ``axis_name``:
+
+    - ``serial``: single shard, no collectives.
+    - ``data_psum``: rows sharded; global histograms via ``psum`` (simple data
+      parallel — every shard scans all features).
+    - ``data_rs``: rows sharded; ``psum_scatter`` shards the *global* histogram
+      over features so each chip scans only F/d features, then an
+      allreduce-argmax of the per-shard bests — the exact comm structure of
+      ``DataParallelTreeLearner`` (data_parallel_tree_learner.cpp:149-240).
+    - ``feature``: rows replicated, histogram work sharded over features
+      (feature_parallel_tree_learner.cpp:33-71); only the tiny best-split
+      allreduce crosses chips.
+    - ``voting``: rows sharded; per-shard top-k feature election + global vote,
+      then psum of only the elected features' histograms
+      (voting_parallel_tree_learner.cpp:170-366).
+    """
+    axis_name: str = ""
+    mode: str = "serial"   # serial | data_psum | data_rs | feature | voting
+    num_shards: int = 1
+    top_k: int = 20
 
 
 class TreeArrays(NamedTuple):
@@ -80,24 +108,96 @@ def _route_left(col, threshold, default_left, mt, nb, dbin):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_leaves", "max_depth", "params", "num_bins", "use_pallas"))
+    static_argnames=("num_leaves", "max_depth", "params", "num_bins", "use_pallas",
+                     "comm"))
 def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                num_data: jax.Array, feature_mask: jax.Array, feat: FeatureInfo,
                *, num_leaves: int, max_depth: int, params: SplitParams,
-               num_bins: int, use_pallas: bool = False) -> TreeArrays:
+               num_bins: int, use_pallas: bool = False,
+               comm: Comm = Comm()) -> TreeArrays:
     """Grow one tree.  grad/hess are pre-masked (bagging/subsample weights applied);
-    ``num_data`` is the in-bag row count."""
+    ``num_data`` is the GLOBAL in-bag row count.
+
+    With ``comm.mode != 'serial'`` this runs inside ``jax.shard_map``: rows (and/or
+    histogram features) are sharded over ``comm.axis_name`` and the reference's
+    three network calls per split (root-sum Allreduce, histogram ReduceScatter,
+    best-split argmax Allreduce — SURVEY.md §3.2) become XLA collectives over ICI.
+    All shards follow identical control flow, so the result is replicated."""
     n, f = bins.shape
     L = num_leaves
     B = num_bins
     f32 = jnp.float32
+    mode = comm.mode
+    d = comm.num_shards
+    ax = comm.axis_name
+    data_sharded = mode in ("data_psum", "data_rs", "voting")
+
+    if mode in ("data_rs", "feature"):
+        assert f % d == 0, "pad features to a multiple of the mesh axis size"
+        chunk = f // d
+        off = jax.lax.axis_index(ax) * chunk
+
+        def _slc(a):
+            return jax.lax.dynamic_slice_in_dim(a, off, chunk, axis=0)
+        feat_c = FeatureInfo(*[_slc(a) for a in feat])
+        mask_c = _slc(feature_mask)
+        ids_c = off + jnp.arange(chunk, dtype=jnp.int32)
+
+    if mode == "voting":
+        # local candidate search scales the per-leaf minimums by 1/num_machines
+        # (voting_parallel_tree_learner.cpp:57-59)
+        vote_params = params._replace(
+            min_data_in_leaf=max(params.min_data_in_leaf // d, 1),
+            min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / d)
+
+    def make_hist(vals):
+        """Stored-histogram block for this shard from masked [N,2] values."""
+        if mode == "feature":
+            bc = jax.lax.dynamic_slice_in_dim(bins, off, chunk, axis=1)
+            return build_histogram(bc, vals, B, use_pallas)
+        h = build_histogram(bins, vals, B, use_pallas)
+        if mode == "data_psum":
+            return jax.lax.psum(h, ax)
+        if mode == "data_rs":
+            return jax.lax.psum_scatter(h, ax, scatter_dimension=0, tiled=True)
+        return h  # serial, voting (kept local)
+
+    def best_of(h, sg, sh, cnt):
+        """Replicated best split from a stored block + GLOBAL leaf sums."""
+        if mode in ("serial", "data_psum"):
+            fb = per_feature_best(h, feat, feature_mask, sg, sh, cnt, params)
+            return reduce_feature_best(fb, jnp.arange(f, dtype=jnp.int32))
+        if mode in ("data_rs", "feature"):
+            fb = per_feature_best(h, feat_c, mask_c, sg, sh, cnt, params)
+            return sync_best(reduce_feature_best(fb, ids_c), ax)
+        # voting: elect 2*top_k features globally, aggregate only those
+        local = jnp.sum(h[0], axis=-1)          # every row hits one bin of feat 0
+        lg, lh = local[0], local[1]
+        lcnt = cnt.astype(f32) * lh / (sh + 1e-15)
+        fb_local = per_feature_best(h, feat, feature_mask, lg, lh, lcnt,
+                                    vote_params)
+        k = min(comm.top_k, f)
+        top_gain, top_ids = jax.lax.top_k(fb_local.gain, k)
+        all_ids = jax.lax.all_gather(top_ids, ax).reshape(-1)
+        all_ok = jax.lax.all_gather(top_gain, ax).reshape(-1) > K_MIN_SCORE
+        votes = jax.ops.segment_sum(all_ok.astype(f32), all_ids, num_segments=f)
+        key = votes - jnp.arange(f, dtype=f32) / (f + 1.0)  # ties → smaller id
+        elected = jnp.sort(jax.lax.top_k(key, min(2 * k, f))[1]).astype(jnp.int32)
+        he = jax.lax.psum(h[elected], ax)
+        feat_e = FeatureInfo(*[a[elected] for a in feat])
+        fb = per_feature_best(he, feat_e, feature_mask[elected], sg, sh, cnt,
+                              params)
+        return reduce_feature_best(fb, elected)
 
     values = jnp.stack([grad, hess], axis=1)
-    hist0 = build_histogram(bins, values, B, use_pallas)
+    hist0 = make_hist(values)
     sum_g = jnp.sum(grad)
     sum_h = jnp.sum(hess)
-    best0 = best_split_numerical(hist0, feat, feature_mask, sum_g, sum_h,
-                                 num_data, params)
+    if data_sharded:
+        # root aggregate Allreduce (data_parallel_tree_learner.cpp:99-146)
+        sum_g = jax.lax.psum(sum_g, ax)
+        sum_h = jax.lax.psum(sum_h, ax)
+    best0 = best_of(hist0, sum_g, sum_h, num_data)
 
     def zl(dtype=f32):
         return jnp.zeros((L,), dtype=dtype)
@@ -112,14 +212,12 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         leaf_parent=jnp.full((L,), -1, dtype=jnp.int32), leaf_depth=zl(jnp.int32),
         num_leaves=jnp.int32(1), row_leaf=jnp.zeros((n,), dtype=jnp.int32))
 
-    hist = jnp.zeros((L, f, 2, B), dtype=f32).at[0].set(hist0)
+    hist = jnp.zeros((L,) + hist0.shape, dtype=f32).at[0].set(hist0)
     bests = BestSplit(*[jnp.broadcast_to(x, (L,) + x.shape).astype(x.dtype)
                         for x in best0])
     state = _State(tree=tree, hist=hist, bests=bests, cont=jnp.bool_(True))
 
-    vmapped_best = jax.vmap(
-        lambda h, g, s, c: best_split_numerical(h, feat, feature_mask, g, s, c,
-                                                params))
+    vmapped_best = jax.vmap(best_of)
 
     def body(k, st: _State) -> _State:
         node = k - 1
@@ -148,7 +246,7 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             smaller_id = jnp.where(left_is_smaller, leaf, k)
             mask = (row_leaf == smaller_id).astype(f32)
             vals = values * mask[:, None]
-            hist_smaller = build_histogram(bins, vals, B, use_pallas)
+            hist_smaller = make_hist(vals)
             hist_larger = st.hist[leaf] - hist_smaller
             hist_left = jnp.where(left_is_smaller, hist_smaller, hist_larger)
             hist_right = jnp.where(left_is_smaller, hist_larger, hist_smaller)
@@ -254,17 +352,24 @@ class SerialTreeLearner:
             is_categorical=jnp.asarray(dataset.feature_is_categorical()))
         # rows padded so the Pallas row tile divides N
         self.num_data = dataset.num_data
-        pad = (-self.num_data) % 1024 if self.use_pallas else 0
-        binned = dataset.binned
-        if pad:
+        self.padded_rows = (-self.num_data) % 1024 if self.use_pallas else 0
+        self._upload_bins(dataset.binned)
+
+    def _pad_host_rows(self, binned: np.ndarray) -> np.ndarray:
+        if self.padded_rows:
             binned = np.concatenate(
-                [binned, np.zeros((pad, binned.shape[1]), dtype=binned.dtype)])
-        self.padded_rows = pad
-        self.bins = jnp.asarray(binned)
+                [binned, np.zeros((self.padded_rows, binned.shape[1]),
+                                  dtype=binned.dtype)])
+        return binned
+
+    def _upload_bins(self, binned: np.ndarray) -> None:
+        self.bins = jnp.asarray(self._pad_host_rows(binned))
 
     def pad_rows(self, arr: jax.Array, value=0.0) -> jax.Array:
-        if self.padded_rows:
-            pad_width = [(0, self.padded_rows)] + [(0, 0)] * (arr.ndim - 1)
+        """Pad a per-row array up to num_data + padded_rows (idempotent)."""
+        short = self.num_data + self.padded_rows - arr.shape[0]
+        if short > 0:
+            pad_width = [(0, short)] + [(0, 0)] * (arr.ndim - 1)
             return jnp.pad(arr, pad_width, constant_values=value)
         return arr
 

@@ -1,0 +1,144 @@
+"""Parallel learner parity on the virtual 8-device CPU mesh.
+
+Mirrors the reference's implicit contract that the parallel learners produce the
+same trees as the serial learner up to float reduction order (the CI strategy of
+running the full behavioral suite through each learner, .ci/test.sh:124-140).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.tree_learner import SerialTreeLearner
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.parallel import (DataParallelPsumTreeLearner,
+                                   DataParallelTreeLearner,
+                                   FeatureParallelTreeLearner,
+                                   VotingParallelTreeLearner,
+                                   create_tree_learner, default_mesh)
+
+N, F = 4000, 11
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(N, F))
+    X[rng.uniform(size=(N, F)) < 0.05] = np.nan  # exercise missing handling
+    y = (np.nan_to_num(X[:, 0]) * 1.5 + np.nan_to_num(X[:, 1]) ** 2
+         + rng.normal(scale=0.1, size=N))
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    grad = jnp.asarray((y - y.mean()).astype(np.float32)) * -1.0
+    hess = jnp.ones((N,), dtype=jnp.float32)
+    return ds, grad, hess
+
+
+def _grow(learner, ds, grad, hess):
+    arrays = learner.train(grad, hess, N)
+    return jax.tree_util.tree_map(np.asarray, arrays)
+
+
+@pytest.fixture(scope="module")
+def serial_tree(problem):
+    ds, grad, hess = problem
+    cfg = Config(num_leaves=15)
+    return _grow(SerialTreeLearner(ds, cfg), ds, grad, hess)
+
+
+@pytest.mark.parametrize("cls", [DataParallelTreeLearner,
+                                 DataParallelPsumTreeLearner,
+                                 FeatureParallelTreeLearner])
+def test_parallel_matches_serial(problem, serial_tree, cls):
+    ds, grad, hess = problem
+    cfg = Config(num_leaves=15)
+    got = _grow(cls(ds, cfg, mesh=default_mesh()), ds, grad, hess)
+    assert int(got.num_leaves) == int(serial_tree.num_leaves)
+    nl = int(got.num_leaves)
+    ni = nl - 1
+    np.testing.assert_array_equal(got.split_feature[:ni],
+                                  serial_tree.split_feature[:ni])
+    np.testing.assert_array_equal(got.threshold_bin[:ni],
+                                  serial_tree.threshold_bin[:ni])
+    np.testing.assert_allclose(got.leaf_value[:nl], serial_tree.leaf_value[:nl],
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(got.row_leaf[:N], serial_tree.row_leaf[:N])
+
+
+def test_voting_grows_reasonable_tree(problem, serial_tree):
+    """Voting is an approximation (top-k election); require a same-size tree
+    whose split features come from the serially-useful set."""
+    ds, grad, hess = problem
+    cfg = Config(num_leaves=15, top_k=5)
+    got = _grow(VotingParallelTreeLearner(ds, cfg, mesh=default_mesh()),
+                ds, grad, hess)
+    assert int(got.num_leaves) == int(serial_tree.num_leaves)
+    # with top_k=5 >= F/2 the election cannot drop the winning features here
+    ni = int(got.num_leaves) - 1
+    np.testing.assert_array_equal(got.split_feature[:ni],
+                                  serial_tree.split_feature[:ni])
+
+
+def test_feature_pad_indivisible(problem):
+    """F=11 does not divide 8 — exercises the feature-padding path."""
+    ds, grad, hess = problem
+    cfg = Config(num_leaves=8)
+    learner = DataParallelTreeLearner(ds, cfg, mesh=default_mesh())
+    assert learner.feature_pad == (-11) % 8
+    got = _grow(learner, ds, grad, hess)
+    assert int(got.num_leaves) == 8
+    assert (got.split_feature[:7] < 11).all()
+
+
+def test_factory_single_device_falls_back_to_serial(problem):
+    ds, _, _ = problem
+    cfg = Config(tree_learner="data")
+    learner = create_tree_learner(ds, cfg, mesh=default_mesh(1))
+    assert type(learner) is SerialTreeLearner
+
+
+def test_factory_names(problem):
+    ds, _, _ = problem
+    for name, cls in [("data", DataParallelTreeLearner),
+                      ("feature", FeatureParallelTreeLearner),
+                      ("voting", VotingParallelTreeLearner)]:
+        learner = create_tree_learner(ds, Config(tree_learner=name))
+        assert type(learner) is cls
+
+
+def test_gbdt_indivisible_rows_and_few_features():
+    """N % num_shards != 0 through the full GBDT loop (regression: grad was
+    double-padded), and F < num_shards auto-selects the psum variant."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.objective import create_objective
+
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(4003, 5))
+    y = X[:, 0] + rng.normal(scale=0.1, size=4003)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=32)
+    cfg = Config(objective="regression", tree_learner="data", num_leaves=7,
+                 num_iterations=3, bagging_fraction=0.8, bagging_freq=1)
+    booster = GBDT(cfg, ds, create_objective("regression", cfg))
+    assert type(booster.learner) is DataParallelPsumTreeLearner  # F=5 < 8
+    for _ in range(3):
+        booster.train_one_iter()
+    assert booster.num_trees == 3
+
+
+def test_gbdt_end_to_end_data_parallel(problem):
+    """Full boosting loop through the data-parallel learner ~= serial."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.objective import create_objective
+
+    ds, _, _ = problem
+    scores = {}
+    for lt in ("serial", "data"):
+        cfg = Config(objective="regression", tree_learner=lt, num_leaves=7,
+                     num_iterations=5, learning_rate=0.2, metric="l2")
+        booster = GBDT(cfg, ds, create_objective("regression", cfg))
+        for _ in range(5):
+            booster.train_one_iter()
+        label = np.asarray(ds.metadata.label)
+        pred = np.asarray(booster.train_score[0, :ds.num_data])
+        scores[lt] = float(np.mean((label - pred) ** 2))
+    assert scores["data"] == pytest.approx(scores["serial"], rel=1e-4)
