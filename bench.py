@@ -1,0 +1,74 @@
+"""Flagship benchmark: Higgs-shaped binary GBDT training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published Higgs number — 10.5M rows x 28 features,
+500 iterations, num_leaves=255 in 238.5 s on a 2x E5-2670v3
+(docs/Experiments.rst:103-117) = 22.01M row-trees/s.  vs_baseline is our
+throughput / reference throughput (>1 = faster than the reference CPU).
+
+Env overrides: BENCH_ROWS, BENCH_ITERS, BENCH_LEAVES, BENCH_BIN.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_ROW_TREES_PER_S = 10_500_000 * 500 / 238.5
+
+
+def main() -> None:
+    import jax
+    from lightgbm_tpu.utils.log import Log
+    Log.reset_level(Log.level_from_verbosity(-1))  # stdout = the JSON line only
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = int(os.environ.get("BENCH_ROWS", 1_000_000 if on_tpu else 50_000))
+    iters = int(os.environ.get("BENCH_ITERS", 20 if on_tpu else 5))
+    leaves = int(os.environ.get("BENCH_LEAVES", 255 if on_tpu else 31))
+    max_bin = int(os.environ.get("BENCH_BIN", 63))
+    f = 28
+    warmup = 2
+
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = (X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] * X[:, 3]
+             + rng.normal(scale=0.5, size=n))
+    y = (logit > 0).astype(np.float64)
+
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=max_bin)
+    cfg = Config(objective="binary", num_leaves=leaves,
+                 num_iterations=iters + warmup, learning_rate=0.1,
+                 max_bin=max_bin)
+    booster = GBDT(cfg, ds, create_objective("binary", cfg))
+
+    for _ in range(warmup):
+        booster.train_one_iter()
+    booster.train_score.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        booster.train_one_iter()
+    booster.train_score.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    row_trees_per_s = n * iters / dt
+    print(json.dumps({
+        "metric": "higgs_shape_train_throughput",
+        "value": round(row_trees_per_s, 1),
+        "unit": "row-trees/s",
+        "vs_baseline": round(row_trees_per_s / BASELINE_ROW_TREES_PER_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,13 +9,15 @@ from ..utils.log import Log
 
 
 class DART(GBDT):
-    def __init__(self, config, train_data=None, objective=None):
+    lazy_trees = False  # dropout shrinks/re-adds host trees every iteration
+
+    def __init__(self, config, train_data=None, objective=None, mesh=None):
         self._drop_rng = np.random.RandomState(int(config.drop_seed))
         self.tree_weight = []
         self.sum_weight = 0.0
         self.drop_index = []
         self._score_is_dropped = False
-        super().__init__(config, train_data, objective)
+        super().__init__(config, train_data, objective, mesh=mesh)
 
     def sub_model_name(self) -> str:
         return "tree"

@@ -41,14 +41,28 @@ MODEL_VERSION = "v3"
 
 
 class GBDT:
-    """Gradient Boosting Decision Tree (sub-model name "tree", gbdt.h:362)."""
+    """Gradient Boosting Decision Tree (sub-model name "tree", gbdt.h:362).
+
+    TPU pipelining: the default training path is fully asynchronous — per
+    iteration it only *dispatches* device work (gradients, tree build, score
+    update) and records lazy handles; host ``Tree`` objects are materialized in
+    one batched device fetch when first needed (save/predict/eval) and the
+    no-more-splits stop condition is polled every ``_poll_freq`` iterations.
+    This keeps the accelerator queue full instead of paying a host round-trip
+    per iteration (the reference's per-iteration host loop is free on CPU but
+    dominates wall-clock on a remote accelerator).  DART (and objectives that
+    renew leaf outputs on the host) use the synchronous path.
+    """
 
     average_output = False
+    lazy_trees = True
 
     def __init__(self, config: Config, train_data: Optional[BinnedDataset] = None,
-                 objective: Optional[ObjectiveFunction] = None) -> None:
+                 objective: Optional[ObjectiveFunction] = None,
+                 mesh=None) -> None:
         self.config = config
-        self.models: List[Tree] = []
+        self.mesh = mesh
+        self.models = []
         self.iter_ = 0
         self.num_init_iteration = 0
         self.train_data: Optional[BinnedDataset] = None
@@ -67,6 +81,77 @@ class GBDT:
         if train_data is not None:
             self.reset_training_data(train_data, objective)
 
+    # ---- lazy tree materialization ----
+
+    @property
+    def models(self) -> List[Tree]:
+        """Host trees; materializes any pending device trees (one batched fetch)."""
+        if self._pending:
+            self._materialize_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value) -> None:
+        self._models: List[Tree] = list(value)
+        self._pending: Dict[int, Tuple[TreeArrays, float]] = {}
+        self._nl_handles: List[Tuple[int, int, jax.Array]] = []
+        self._last_poll = 0
+
+    def _materialize_pending(self) -> None:
+        idxs = sorted(self._pending)
+        recs = [self._pending[i] for i in idxs]
+        self._pending = {}
+        host = jax.device_get([r[0] for r in recs])  # ONE device round-trip
+        for i, rec, arr in zip(idxs, recs, host):
+            tree = tree_from_arrays(arr, self.train_data, 1.0)
+            if abs(rec[1]) > K_EPSILON:
+                tree.add_bias(rec[1])
+            self._models[i] = tree
+
+    def _route_arrays_valid(self, arrays: TreeArrays, class_id: int,
+                            vs: dict) -> None:
+        """Validation score update straight from device tree arrays."""
+        leaf = route_binned(vs["bins"], arrays, self.learner.feat,
+                            num_leaves=int(self.config.num_leaves))
+        vs["score"] = vs["score"].at[class_id].add(arrays.leaf_value[leaf])
+
+    def _poll_stop(self) -> bool:
+        """Deferred no-more-splits check (the reference checks every iteration,
+        gbdt.cpp:439-450; here that host sync is amortized over _poll_freq
+        iterations).  Trims any iterations past the first fully-stalled one —
+        exactly where the reference would have stopped — and undoes their score
+        contributions."""
+        self._last_poll = self.iter_
+        if not self._nl_handles:
+            return False
+        nls = jax.device_get([h for _, _, h in self._nl_handles])
+        by_iter: Dict[int, List[int]] = {}
+        first_idx: Dict[int, int] = {}
+        for (it, idx, _), nl in zip(self._nl_handles, nls):
+            by_iter.setdefault(it, []).append(int(nl))
+            first_idx[it] = min(first_idx.get(it, idx), idx)
+        stalled = sorted(it for it, v in by_iter.items() if max(v) <= 1)
+        if not stalled:
+            self._nl_handles = []
+            return False
+        first = stalled[0]
+        cut = first_idx[first]
+        for idx in sorted(i for i in self._pending if i >= cut):
+            arrays, _ = self._pending.pop(idx)
+            k = idx % self.num_tree_per_iteration
+            self.train_score = self.train_score.at[k].add(
+                -self._gather_tree_output(arrays))
+            for vs in self.valid_sets:
+                leaf = route_binned(vs["bins"], arrays, self.learner.feat,
+                                    num_leaves=int(self.config.num_leaves))
+                vs["score"] = vs["score"].at[k].add(-arrays.leaf_value[leaf])
+        del self._models[cut:]
+        self._nl_handles = []
+        self.iter_ = first
+        Log.warning("Stopped training because there are no more leaves "
+                    "that meet the split requirements")
+        return True
+
     # ---- setup ----
 
     def reset_training_data(self, train_data: BinnedDataset,
@@ -76,7 +161,8 @@ class GBDT:
         self.num_data = train_data.num_data
         self.num_tree_per_iteration = (objective.num_model_per_iteration
                                        if objective else max(1, self.num_class))
-        self.learner = create_tree_learner(train_data, self.config)
+        self.learner = create_tree_learner(train_data, self.config,
+                                           mesh=self.mesh)
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
         self.feature_infos = train_data.feature_infos()
@@ -244,7 +330,7 @@ class GBDT:
     # ---- boosting (gbdt.cpp:143-158, 322-368) ----
 
     def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
-        if (not self.models and not self._has_init_score
+        if (not self._models and not self._has_init_score
                 and self.objective is not None):
             if self.config.boost_from_average or self.train_data.num_features == 0:
                 init_score = self.objective.boost_from_score(class_id)
@@ -271,9 +357,83 @@ class GBDT:
 
     # ---- the iteration ----
 
+    _poll_freq = 16
+
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training cannot continue (no splittable leaves)."""
+        use_lazy = (self.lazy_trees
+                    and not (self.objective is not None
+                             and self.objective.is_renew_tree_output))
+        if not use_lazy:
+            return self._train_one_iter_sync(gradients, hessians)
+
+        K = self.num_tree_per_iteration
+        init_scores = [0.0] * K
+        if gradients is None or hessians is None:
+            for k in range(K):
+                init_scores[k] = self._boost_from_average(k, True)
+            grad, hess = self._get_gradients()
+        else:
+            grad = jnp.asarray(np.asarray(gradients, dtype=np.float32)).reshape(
+                K, self.num_data)
+            hess = jnp.asarray(np.asarray(hessians, dtype=np.float32)).reshape(
+                K, self.num_data)
+        self._bagging(self.iter_)
+        grad, hess = self._adjust_gradients_for_bagging(grad, hess)
+
+        feature_mask = self._feature_mask()
+        self._last_iter_arrays = []
+        any_trained = False
+        for k in range(K):
+            if self.class_need_train[k] and self.train_data.num_features > 0:
+                any_trained = True
+                gk = self.learner.pad_rows(grad[k])
+                hk = self.learner.pad_rows(hess[k])
+                if self.bag_mask is not None:
+                    gk = gk * self.bag_mask
+                    hk = hk * self.bag_mask
+                arrays = self.learner.train(gk, hk, self.bag_data_cnt,
+                                            feature_mask)
+                rate = self.shrinkage_rate
+                scaled = arrays._replace(
+                    leaf_value=arrays.leaf_value * rate,
+                    internal_value=arrays.internal_value * rate)
+                self.train_score = self.train_score.at[k].add(
+                    self._gather_tree_output(scaled))
+                for vs in self.valid_sets:
+                    self._route_arrays_valid(scaled, k, vs)
+                idx = len(self._models)
+                self._models.append(None)
+                self._pending[idx] = (scaled, init_scores[k])
+                self._nl_handles.append((self.iter_, idx, scaled.num_leaves))
+                self._last_iter_arrays.append(scaled)
+            else:
+                new_tree = Tree(1)
+                if len(self._models) < K:
+                    output = (self.objective.boost_from_score(k)
+                              if (not self.class_need_train[k]
+                                  and self.objective is not None)
+                              else init_scores[k])
+                    new_tree.leaf_value[0] = output
+                    if abs(output) > K_EPSILON:
+                        self._add_constant_score(output, k)
+                self._models.append(new_tree)
+                self._last_iter_arrays.append(None)
+
+        if not any_trained:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+        self.iter_ += 1
+        if self.iter_ - self._last_poll >= self._poll_freq:
+            return self._poll_stop()
+        return False
+
+    def _train_one_iter_sync(self, gradients: Optional[np.ndarray] = None,
+                             hessians: Optional[np.ndarray] = None) -> bool:
+        """Synchronous path (host Tree per iteration): DART and leaf-renewal
+        objectives need host trees eagerly."""
         init_scores = [0.0] * self.num_tree_per_iteration
         if gradients is None or hessians is None:
             for k in range(self.num_tree_per_iteration):
@@ -412,6 +572,8 @@ class GBDT:
                     and (it + 1) % self.config.snapshot_freq == 0):
                 path = "%s.snapshot_iter_%d" % (snapshot_out, it + 1)
                 self.save_model(path)
+        if self._pending:
+            self._poll_stop()  # trim any trailing stalled iterations
 
     # ---- evaluation ----
 
@@ -603,8 +765,8 @@ class GBDT:
 
     @property
     def num_trees(self) -> int:
-        return len(self.models)
+        return len(self._models)
 
     @property
     def current_iteration(self) -> int:
-        return len(self.models) // max(self.num_tree_per_iteration, 1)
+        return len(self._models) // max(self.num_tree_per_iteration, 1)

@@ -15,8 +15,8 @@ from ..utils.log import Log
 
 
 class GOSS(GBDT):
-    def __init__(self, config, train_data=None, objective=None):
-        super().__init__(config, train_data, objective)
+    def __init__(self, config, train_data=None, objective=None, mesh=None):
+        super().__init__(config, train_data, objective, mesh=mesh)
         if config.top_rate + config.other_rate > 1.0:
             Log.fatal("top_rate + other_rate cannot be larger than 1.0 in GOSS")
         if config.top_rate <= 0.0 or config.other_rate <= 0.0:

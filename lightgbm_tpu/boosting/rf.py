@@ -15,8 +15,8 @@ K_EPSILON = 1e-15
 class RF(GBDT):
     average_output = True
 
-    def __init__(self, config, train_data=None, objective=None):
-        super().__init__(config, train_data, objective)
+    def __init__(self, config, train_data=None, objective=None, mesh=None):
+        super().__init__(config, train_data, objective, mesh=mesh)
         self.shrinkage_rate = 1.0
         self._init_scores = [0.0] * self.num_tree_per_iteration
         if objective is None:

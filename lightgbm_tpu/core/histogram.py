@@ -25,6 +25,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _LANE = 128
 
@@ -55,16 +56,15 @@ def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_features: int, num_bins: in
     vals = vals_ref[...]                            # [Nt, 2]
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
 
-    def body(f, _):
-        col = jax.lax.dynamic_slice_in_dim(bins, f, 1, axis=1)      # [Nt, 1]
+    # static unroll over features (Mosaic TC has no dynamic_slice); each step is
+    # a [2, Nt] x [Nt, B] one-hot contraction on the MXU
+    for f in range(num_features):
+        col = bins[:, f:f + 1]                                      # [Nt, 1]
         onehot = (col == iota).astype(jnp.float32)                  # [Nt, B]
         acc = jax.lax.dot_general(vals, onehot, (((0,), (0,)), ((), ())),
                                   precision=jax.lax.Precision.HIGHEST,
                                   preferred_element_type=jnp.float32)  # [2, B]
-        out_ref[pl.ds(f, 1), :, :] += acc[None]
-        return 0
-
-    jax.lax.fori_loop(0, num_features, body, 0)
+        out_ref[f, :, :] += acc
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile", "interpret"))
@@ -93,14 +93,91 @@ def histogram_pallas(bins: jax.Array, values: jax.Array, num_bins: int,
     )(bins.astype(jnp.int32), values)
 
 
+def _pick_tile(n: int) -> int | None:
+    for tile in (4096, 2048, 1024):
+        if n % tile == 0:
+            return tile
+    return None
+
+
 def build_histogram(bins: jax.Array, values: jax.Array, num_bins: int,
                     use_pallas: bool | None = None) -> jax.Array:
     """Dispatch: Pallas on TPU, segment-sum elsewhere.  [F, 2, B] f32 output."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
-        n = bins.shape[0]
-        tile = 2048 if n % 2048 == 0 else (1024 if n % 1024 == 0 else None)
+        tile = _pick_tile(bins.shape[0])
         if tile is not None:
             return histogram_pallas(bins, values, num_bins, row_tile=tile)
+    return histogram_xla(bins, values, num_bins)
+
+
+def _hist_kernel_bounded(cnt_ref, bins_ref, vals_ref, out_ref, *,
+                         num_features: int, num_bins: int, row_tile: int):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    # tiles beyond the active row count skip both compute and (via the
+    # cnt-dependent index_map) the HBM fetch — cost scales with cnt, not N
+    @pl.when(pl.program_id(0) * row_tile < cnt_ref[0])
+    def _accum():
+        bins = bins_ref[...].astype(jnp.int32)
+        vals = vals_ref[...]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
+        for f in range(num_features):
+            onehot = (bins[:, f:f + 1] == iota).astype(jnp.float32)
+            acc = jax.lax.dot_general(vals, onehot, (((0,), (0,)), ((), ())),
+                                      precision=jax.lax.Precision.HIGHEST,
+                                      preferred_element_type=jnp.float32)
+            out_ref[f, :, :] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile"))
+def histogram_pallas_bounded(bins: jax.Array, values: jax.Array, num_bins: int,
+                             cnt: jax.Array, row_tile: int = 4096) -> jax.Array:
+    """Histogram over the first ``cnt`` rows of a compacted matrix.
+
+    The counterpart of the reference's per-leaf ``data_indices`` histograms
+    (dense_bin.hpp:48 ConstructHistogram over ordered indices): rows of one leaf
+    are gathered to the front, ``cnt`` rides scalar prefetch, and tiles past the
+    count are skipped.  values beyond cnt MUST already be zeroed (safety net for
+    the partial tile)."""
+    n, f = bins.shape
+    assert n % row_tile == 0, "pad rows to a multiple of row_tile"
+    grid = (n // row_tile,)
+
+    def _in_idx(i, cnt_ref):
+        # revisit block 0 for skipped tiles: Mosaic elides the re-fetch
+        return (jnp.where(i * row_tile < cnt_ref[0], i, 0), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((row_tile, f), _in_idx),
+            pl.BlockSpec((row_tile, 2), _in_idx),
+        ],
+        out_specs=pl.BlockSpec((f, 2, num_bins), lambda i, cnt_ref: (0, 0, 0)),
+    )
+    kernel = functools.partial(_hist_kernel_bounded, num_features=f,
+                               num_bins=num_bins, row_tile=row_tile)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((f, 2, num_bins), jnp.float32),
+    )(cnt.reshape(1).astype(jnp.int32), bins.astype(jnp.int32), values)
+
+
+def build_histogram_bounded(bins: jax.Array, values: jax.Array, num_bins: int,
+                            cnt: jax.Array,
+                            use_pallas: bool | None = None) -> jax.Array:
+    """Bounded-row histogram dispatch; values past cnt must be zero."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        tile = _pick_tile(bins.shape[0])
+        if tile is not None:
+            return histogram_pallas_bounded(bins, values, num_bins, cnt,
+                                            row_tile=tile)
     return histogram_xla(bins, values, num_bins)

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import build_histogram, _pad_bins
+from .histogram import build_histogram, build_histogram_bounded, _pad_bins
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, reduce_feature_best, sync_best,
                     K_MIN_SCORE)
@@ -150,17 +150,31 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             min_data_in_leaf=max(params.min_data_in_leaf // d, 1),
             min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / d)
 
+    def _reduce_hist(h):
+        if mode == "data_psum":
+            return jax.lax.psum(h, ax)
+        if mode == "data_rs":
+            return jax.lax.psum_scatter(h, ax, scatter_dimension=0, tiled=True)
+        return h  # serial, feature, voting (kept local)
+
     def make_hist(vals):
         """Stored-histogram block for this shard from masked [N,2] values."""
         if mode == "feature":
             bc = jax.lax.dynamic_slice_in_dim(bins, off, chunk, axis=1)
             return build_histogram(bc, vals, B, use_pallas)
-        h = build_histogram(bins, vals, B, use_pallas)
-        if mode == "data_psum":
-            return jax.lax.psum(h, ax)
-        if mode == "data_rs":
-            return jax.lax.psum_scatter(h, ax, scatter_dimension=0, tiled=True)
-        return h  # serial, voting (kept local)
+        return _reduce_hist(build_histogram(bins, vals, B, use_pallas))
+
+    def make_hist_sub(values, mask_b):
+        """Histogram of the rows where mask_b (the smaller child).
+
+        Full-N masked pass: XLA row gathers cost ~10-25 ns/row on TPU (per-row
+        DMA), so physically compacting the child's rows (tried; the reference's
+        DataPartition approach, data_partition.hpp:113) LOSES to streaming all
+        rows through the one-hot-matmul kernel with zeroed values.  The win to
+        chase instead is windowed periodic repartition (sort rows by leaf once
+        per level, then the bounded kernel skips tiles outside the leaf's
+        window — see histogram_pallas_bounded)."""
+        return make_hist(values * mask_b.astype(f32)[:, None])
 
     def best_of(h, sg, sh, cnt):
         """Replicated best split from a stored block + GLOBAL leaf sums."""
@@ -244,9 +258,7 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # histogram for the smaller child; sibling by subtraction (:347-356)
             left_is_smaller = b.left_count <= b.right_count
             smaller_id = jnp.where(left_is_smaller, leaf, k)
-            mask = (row_leaf == smaller_id).astype(f32)
-            vals = values * mask[:, None]
-            hist_smaller = make_hist(vals)
+            hist_smaller = make_hist_sub(values, row_leaf == smaller_id)
             hist_larger = st.hist[leaf] - hist_smaller
             hist_left = jnp.where(left_is_smaller, hist_smaller, hist_larger)
             hist_right = jnp.where(left_is_smaller, hist_larger, hist_smaller)
