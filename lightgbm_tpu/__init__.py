@@ -1,0 +1,33 @@
+"""LightGBM-TPU: a TPU-native gradient boosting framework.
+
+Same public surface as the reference's python-package
+(python-package/lightgbm/__init__.py): Dataset/Booster, train/cv, sklearn
+wrappers, callbacks, plotting — backed by JAX/XLA/Pallas device compute
+instead of the C++ core.
+"""
+from .basic import Booster, Dataset
+from .callback import (EarlyStopException, early_stopping, print_evaluation,
+                       record_evaluation, reset_parameter)
+from .engine import CVBooster, cv, train
+from .utils.log import LightGBMError
+
+try:
+    from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+except ImportError:  # pragma: no cover
+    pass
+
+try:
+    from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                           plot_split_value_histogram, plot_tree)
+except ImportError:  # pragma: no cover
+    pass
+
+__version__ = "2.3.2"
+
+__all__ = ["Dataset", "Booster", "CVBooster", "LightGBMError",
+           "train", "cv",
+           "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+           "early_stopping", "print_evaluation", "record_evaluation",
+           "reset_parameter", "EarlyStopException",
+           "plot_importance", "plot_split_value_histogram", "plot_metric",
+           "plot_tree", "create_tree_digraph"]

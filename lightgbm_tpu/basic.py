@@ -1,0 +1,515 @@
+"""Public ``Dataset`` and ``Booster`` (python-package/lightgbm/basic.py).
+
+The reference's basic.py is a ctypes wrapper over the C API; here the same
+surface fronts the in-process TPU engine (BinnedDataset + boosting classes)
+directly — no C ABI hop on the training path.  Semantics mirrored:
+lazy Dataset construction with reference alignment (basic.py:712 _lazy_init),
+pandas/categorical handling (basic.py:263 _data_from_pandas), Booster
+train/eval/predict/save (basic.py:1666+).
+"""
+from __future__ import annotations
+
+import json
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .compat import PANDAS_INSTALLED, DataFrame, Series
+from .config import Config, alias_transform
+from .boosting import create_boosting
+from .boosting.gbdt import GBDT
+from .io.dataset import BinnedDataset
+from .metric.metric import create_metrics
+from .objective import create_objective
+from .utils.log import Log, LightGBMError
+
+__all__ = ["Dataset", "Booster", "LightGBMError"]
+
+_PANDAS_DTYPE_MAP = {"int8": np.float64, "int16": np.float64, "int32": np.float64,
+                     "int64": np.float64, "uint8": np.float64, "uint16": np.float64,
+                     "uint32": np.float64, "uint64": np.float64,
+                     "float16": np.float64, "float32": np.float64,
+                     "float64": np.float64, "bool": np.float64}
+
+
+def _list_to_1d_numpy(data, dtype=np.float32, name="list"):
+    if data is None:
+        return None
+    if PANDAS_INSTALLED and isinstance(data, Series):
+        data = data.values
+    arr = np.asarray(data, dtype=dtype)
+    if arr.ndim != 1:
+        arr = arr.ravel()
+    return arr
+
+
+def _data_from_pandas(data, feature_name, categorical_feature):
+    """DataFrame -> (float64 matrix, names, categorical indices); category
+    columns are code-mapped with -1 -> NaN (basic.py:263-330)."""
+    if data.shape[0] == 0:
+        raise LightGBMError("Input data must not be empty")
+    names = [str(c) for c in data.columns]
+    cat_cols = [i for i, c in enumerate(data.columns)
+                if str(data[c].dtype) == "category"]
+    if categorical_feature == "auto":
+        categorical = cat_cols
+    elif categorical_feature is None:
+        categorical = []
+    else:
+        categorical = []
+        for c in categorical_feature:
+            if isinstance(c, str):
+                if c in names:
+                    categorical.append(names.index(c))
+            else:
+                categorical.append(int(c))
+    out = np.empty(data.shape, dtype=np.float64)
+    for i, c in enumerate(data.columns):
+        col = data[c]
+        if str(col.dtype) == "category":
+            codes = col.cat.codes.values.astype(np.float64)
+            codes[codes < 0] = np.nan
+            out[:, i] = codes
+        else:
+            if str(col.dtype) not in _PANDAS_DTYPE_MAP:
+                raise LightGBMError(
+                    "DataFrame.dtypes for data must be int, float or bool. "
+                    "Did not expect the data types in field %s" % c)
+            out[:, i] = col.values.astype(np.float64)
+    if feature_name == "auto":
+        feature_name = names
+    return out, feature_name, categorical
+
+
+def _to_matrix(data, feature_name="auto", categorical_feature="auto"):
+    """Accept numpy/pandas/list/scipy-sparse; return dense float64 matrix."""
+    if PANDAS_INSTALLED and isinstance(data, DataFrame):
+        return _data_from_pandas(data, feature_name, categorical_feature)
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(data):
+            data = np.asarray(data.todense(), dtype=np.float64)
+    except ImportError:
+        pass
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    cats = ([] if categorical_feature in ("auto", None)
+            else [int(c) for c in categorical_feature])
+    names = None if feature_name == "auto" else list(feature_name)
+    return arr, names, cats
+
+
+class Dataset:
+    """Dataset for training/validation — lazily constructed binned matrix."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name="auto", categorical_feature="auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, silent: bool = False) -> None:
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = deepcopy(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self.silent = silent
+        self.handle: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+    # ---- construction (basic.py:712 _lazy_init) ----
+
+    def construct(self) -> "Dataset":
+        if self.handle is not None:
+            return self
+        if self.used_indices is not None:
+            ref = self.reference.construct()
+            self.handle = ref.handle.subset(np.asarray(self.used_indices))
+            if self.label is not None:
+                self.handle.metadata.set_label(
+                    _list_to_1d_numpy(self.label, np.float64, "label"))
+            return self
+        mat, names, cats = _to_matrix(self.data, self.feature_name,
+                                      self.categorical_feature)
+        cfg = Config(alias_transform(dict(self.params)))
+        label = _list_to_1d_numpy(self.label, np.float64, "label")
+        weight = _list_to_1d_numpy(self.weight, np.float64, "weight")
+        group = _list_to_1d_numpy(self.group, np.int32, "group")
+        init_score = _list_to_1d_numpy(self.init_score, np.float64, "init_score")
+        ref_handle = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_handle = self.reference.handle
+        self.handle = BinnedDataset.from_matrix(
+            mat, label=label, weight=weight, group=group, init_score=init_score,
+            max_bin=int(cfg.max_bin), min_data_in_bin=int(cfg.min_data_in_bin),
+            min_data_in_leaf=int(cfg.min_data_in_leaf),
+            bin_construct_sample_cnt=int(cfg.bin_construct_sample_cnt),
+            categorical_feature=cats or (),
+            use_missing=bool(cfg.use_missing),
+            zero_as_missing=bool(cfg.zero_as_missing),
+            data_random_seed=int(cfg.data_random_seed),
+            feature_names=names, reference=ref_handle,
+            keep_raw=not self.free_raw_data)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None, silent=False) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       feature_name=self.feature_name,
+                       categorical_feature=self.categorical_feature,
+                       params=params or self.params, silent=silent)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        ret = Dataset(None, reference=self, feature_name=self.feature_name,
+                      categorical_feature=self.categorical_feature,
+                      params=params or self.params, free_raw_data=self.free_raw_data)
+        ret.used_indices = np.sort(np.asarray(used_indices))
+        return ret
+
+    # ---- field get/set ----
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self.handle is not None:
+            self.handle.metadata.set_label(
+                _list_to_1d_numpy(label, np.float64, "label"))
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self.handle is not None and weight is not None:
+            self.handle.metadata.set_weights(
+                _list_to_1d_numpy(weight, np.float64, "weight"))
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self.handle is not None and group is not None:
+            self.handle.metadata.set_group(
+                _list_to_1d_numpy(group, np.int32, "group"))
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self.handle is not None and init_score is not None:
+            self.handle.metadata.set_init_score(
+                _list_to_1d_numpy(init_score, np.float64, "init_score"))
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        self.reference = reference
+        return self
+
+    def get_label(self):
+        if self.handle is not None:
+            return np.asarray(self.handle.metadata.label)
+        return self.label
+
+    def get_weight(self):
+        if self.handle is not None:
+            return self.handle.metadata.weights
+        return self.weight
+
+    def get_group(self):
+        if self.handle is not None and self.handle.metadata.query_boundaries is not None:
+            return np.diff(self.handle.metadata.query_boundaries)
+        return self.group
+
+    def get_init_score(self):
+        if self.handle is not None:
+            return self.handle.metadata.init_score
+        return self.init_score
+
+    def get_data(self):
+        return self.data
+
+    def get_field(self, field_name):
+        getter = {"label": self.get_label, "weight": self.get_weight,
+                  "group": self.get_group, "init_score": self.get_init_score}
+        if field_name not in getter:
+            raise LightGBMError("Unknown field name %s" % field_name)
+        return getter[field_name]()
+
+    def set_field(self, field_name, data):
+        setter = {"label": self.set_label, "weight": self.set_weight,
+                  "group": self.set_group, "init_score": self.set_init_score}
+        if field_name not in setter:
+            raise LightGBMError("Unknown field name %s" % field_name)
+        return setter[field_name](data)
+
+    def num_data(self) -> int:
+        self.construct()
+        return self.handle.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self.handle.num_total_features
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self.handle.feature_names)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()
+        self.handle.save_binary(filename)
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self.handle is not None:
+            raise LightGBMError(
+                "Cannot set categorical feature after freed raw data")
+        self.categorical_feature = categorical_feature
+        return self
+
+
+_DATASET_PARAMS = {"max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+                   "min_data_in_leaf", "use_missing", "zero_as_missing",
+                   "data_random_seed"}
+
+
+class Booster:
+    """Booster: thin host object over the boosting engine (basic.py:1666)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent: bool = False) -> None:
+        self.params = deepcopy(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._train_set = train_set
+        self._valid_sets: List[Dataset] = []
+        self.name_valid_sets: List[str] = []
+        self._feval_cache: Dict = {}
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance, met "
+                                + type(train_set).__name__)
+            train_set.construct()
+            self.config = Config(self.params)
+            objective = create_objective(self.config.objective, self.config)
+            self._booster: GBDT = create_boosting(
+                self.config.boosting, self.config, train_set.handle, objective)
+            self._booster.add_train_metrics(
+                create_metrics(self.config.metric, self.config))
+        elif model_file is not None:
+            self.config = Config(self.params)
+            self._booster = GBDT.load_model(model_file, self.config)
+        elif model_str is not None:
+            self.config = Config(self.params)
+            self._booster = GBDT(self.config)
+            self._booster.load_model_from_string(model_str)
+        else:
+            raise TypeError("Need at least one training dataset or model file "
+                            "or model string to create Booster instance")
+
+    # ---- training ----
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if no further splits possible."""
+        if train_set is not None and train_set is not self._train_set:
+            train_set.construct()
+            self._train_set = train_set
+            self._booster.reset_training_data(train_set.handle,
+                                              self._booster.objective)
+        if fobj is None:
+            return self._booster.train_one_iter()
+        grad, hess = fobj(self._flat_score("train"), self._train_set)
+        return self._booster.train_one_iter(np.asarray(grad, dtype=np.float32),
+                                            np.asarray(hess, dtype=np.float32))
+
+    def rollback_one_iter(self) -> "Booster":
+        self._booster.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._booster.current_iteration
+
+    def num_trees(self) -> int:
+        return self._booster.num_trees
+
+    def num_model_per_iteration(self) -> int:
+        return self._booster.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return self._booster.max_feature_idx + 1
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self.config.set(alias_transform(params))
+        if "learning_rate" in alias_transform(params):
+            self._booster.shrinkage_rate = float(self.config.learning_rate)
+        return self
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if not isinstance(data, Dataset):
+            raise TypeError("Validation data should be Dataset instance, met "
+                            + type(data).__name__)
+        data.construct()
+        self._booster.add_valid_data(data.handle, name)
+        self._valid_sets.append(data)
+        self.name_valid_sets.append(name)
+        return self
+
+    # ---- evaluation ----
+
+    def _flat_score(self, which: Union[str, int]) -> np.ndarray:
+        """Raw scores of train ('train') or the i-th validation set."""
+        b = self._booster
+        if which == "train":
+            score = np.asarray(b.get_training_score()[:, :b.num_data])
+        else:
+            score = np.asarray(b.valid_sets[which]["score"])
+        if score.shape[0] == 1:
+            return score[0].astype(np.float64)
+        return score.T.reshape(-1, order="F").astype(np.float64)
+
+    def _apply_feval(self, feval, which, data: Dataset, data_name: str):
+        out = []
+        if feval is None:
+            return out
+        ret = feval(self._flat_score(which), data)
+        if ret is None:
+            return out
+        if isinstance(ret, list):
+            for name, val, hib in ret:
+                out.append((data_name, name, val, hib))
+        else:
+            name, val, hib = ret
+            out.append((data_name, name, val, hib))
+        return out
+
+    def eval_train(self, feval=None) -> List:
+        out = self._booster.eval_train()
+        out += self._apply_feval(feval, "train", self._train_set, "training")
+        return out
+
+    def eval_valid(self, feval=None) -> List:
+        out = self._booster.eval_valid()
+        for i, (vs, name) in enumerate(zip(self._valid_sets,
+                                           self.name_valid_sets)):
+            out += self._apply_feval(feval, i, vs, name)
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List:
+        if data is self._train_set:
+            return [(name, m, v, h) for (_, m, v, h) in self.eval_train(feval)]
+        for i, vs in enumerate(self._valid_sets):
+            if data is vs:
+                res = self._booster.eval_valid()
+                out = [r for r in res if r[0] == self.name_valid_sets[i]]
+                out += self._apply_feval(feval, i, vs, name)
+                return out
+        raise LightGBMError("Data should be added in Booster.add_valid() first")
+
+    # ---- prediction ----
+
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        if isinstance(data, Dataset):
+            raise TypeError("Cannot use Dataset instance for prediction, "
+                            "please use raw data instead")
+        mat, _, _ = _to_matrix(data)
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if pred_leaf:
+            return self._booster.predict_leaf_index(mat, num_iteration)
+        if pred_contrib:
+            return self._booster.predict_contrib(mat, num_iteration)
+        return self._booster.predict(mat, raw_score=raw_score,
+                                     num_iteration=num_iteration,
+                                     start_iteration=start_iteration)
+
+    # ---- model IO ----
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        self._booster.save_model(filename, start_iteration, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return self._booster.save_model_to_string(start_iteration, num_iteration)
+
+    def model_from_string(self, model_str: str, verbose: bool = True) -> "Booster":
+        self._booster = GBDT(self.config if hasattr(self, "config") else Config())
+        self._booster.load_model_from_string(model_str)
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict:
+        b = self._booster
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        K = b.num_tree_per_iteration
+        total_iter = len(b.models) // max(K, 1)
+        end_iter = total_iter if num_iteration <= 0 else min(
+            total_iter, start_iteration + num_iteration)
+        trees = []
+        for i in range(start_iteration * K, end_iter * K):
+            trees.append({"tree_index": i, "tree_structure": b.models[i].to_json()})
+        return {
+            "name": b.sub_model_name(),
+            "version": "v3",
+            "num_class": b.num_class,
+            "num_tree_per_iteration": K,
+            "label_index": b.label_idx,
+            "max_feature_idx": b.max_feature_idx,
+            "objective": b.objective.to_string() if b.objective else "none",
+            "average_output": b.average_output,
+            "feature_names": list(b.feature_names),
+            "feature_importances": {
+                name: int(v) for name, v in zip(
+                    b.feature_names, b.feature_importance("split"))
+                if v > 0},
+            "tree_info": trees,
+        }
+
+    # ---- introspection ----
+
+    def feature_name(self) -> List[str]:
+        return list(self._booster.feature_names)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        imp = self._booster.feature_importance(
+            importance_type, -1 if iteration is None else iteration)
+        if importance_type == "split":
+            return imp.astype(np.int32)
+        return imp
+
+    def __getstate__(self):
+        # pickling drops the live train/valid handles, keeps the model text
+        state = {"params": self.params,
+                 "model_str": self._booster.save_model_to_string(),
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score}
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+        self._train_set = None
+        self._valid_sets = []
+        self.name_valid_sets = []
+        self.config = Config(self.params)
+        self._booster = GBDT(self.config)
+        self._booster.load_model_from_string(state["model_str"])

@@ -638,6 +638,20 @@ class GBDT:
             raw = np.asarray(self.objective.convert_output(raw))
         return raw[0] if self.num_tree_per_iteration == 1 else raw.T
 
+    def predict_contrib(self, X: np.ndarray,
+                        num_iteration: int = -1) -> np.ndarray:
+        """SHAP feature contributions (tree.h:133 PredictContrib); implemented
+        with Tree.predict_contrib once available."""
+        K = self.num_tree_per_iteration
+        total_iter = len(self.models) // K
+        end = total_iter if num_iteration <= 0 else min(total_iter, num_iteration)
+        n = len(X)
+        ncol = self.max_feature_idx + 2
+        out = np.zeros((K, n, ncol), dtype=np.float64)
+        for i in range(end * K):
+            out[i % K] += self.models[i].predict_contrib(X, ncol)
+        return out[0] if K == 1 else np.concatenate(out, axis=1)
+
     def predict_leaf_index(self, X: np.ndarray,
                            num_iteration: int = -1) -> np.ndarray:
         K = self.num_tree_per_iteration

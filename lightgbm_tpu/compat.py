@@ -1,0 +1,86 @@
+"""Optional-dependency shims (python-package/lightgbm/compat.py)."""
+from __future__ import annotations
+
+try:
+    from pandas import DataFrame, Series
+    PANDAS_INSTALLED = True
+except ImportError:
+    PANDAS_INSTALLED = False
+
+    class DataFrame:  # type: ignore[no-redef]
+        pass
+
+    class Series:  # type: ignore[no-redef]
+        pass
+
+try:
+    from sklearn.base import BaseEstimator as _SKBaseEstimator
+    from sklearn.base import ClassifierMixin as _SKClassifierMixin
+    from sklearn.base import RegressorMixin as _SKRegressorMixin
+    from sklearn.preprocessing import LabelEncoder as _SKLabelEncoder
+    from sklearn.utils.multiclass import check_classification_targets
+    from sklearn.utils.validation import check_array, check_X_y
+    SKLEARN_INSTALLED = True
+    _LGBMModelBase = _SKBaseEstimator
+    _LGBMClassifierBase = _SKClassifierMixin
+    _LGBMRegressorBase = _SKRegressorMixin
+    LGBMLabelEncoder = _SKLabelEncoder
+    _LGBMCheckArray = check_array
+    _LGBMCheckXY = check_X_y
+    _LGBMCheckClassificationTargets = check_classification_targets
+except ImportError:
+    SKLEARN_INSTALLED = False
+    import numpy as _np
+
+    class _LGBMModelBase:  # type: ignore[no-redef]
+        def get_params(self, deep=True):
+            import inspect
+            sig = inspect.signature(self.__init__)
+            return {k: getattr(self, k) for k in sig.parameters
+                    if k not in ("self", "kwargs")}
+
+        def set_params(self, **params):
+            for k, v in params.items():
+                setattr(self, k, v)
+            return self
+
+    class _LGBMClassifierBase:  # type: ignore[no-redef]
+        pass
+
+    class _LGBMRegressorBase:  # type: ignore[no-redef]
+        pass
+
+    class LGBMLabelEncoder:  # type: ignore[no-redef]
+        def fit(self, y):
+            self.classes_ = _np.unique(_np.asarray(y))
+            return self
+
+        def transform(self, y):
+            return _np.searchsorted(self.classes_, _np.asarray(y))
+
+        def fit_transform(self, y):
+            return self.fit(y).transform(y)
+
+        def inverse_transform(self, y):
+            return self.classes_[_np.asarray(y, dtype=int)]
+
+    def _LGBMCheckArray(X, **kwargs):  # type: ignore[no-redef]
+        return _np.asarray(X)
+
+    def _LGBMCheckXY(X, y, **kwargs):  # type: ignore[no-redef]
+        return _np.asarray(X), _np.asarray(y)
+
+    def _LGBMCheckClassificationTargets(y):  # type: ignore[no-redef]
+        return None
+
+try:
+    from matplotlib import pyplot  # noqa: F401
+    MATPLOTLIB_INSTALLED = True
+except ImportError:
+    MATPLOTLIB_INSTALLED = False
+
+try:
+    import graphviz  # noqa: F401
+    GRAPHVIZ_INSTALLED = True
+except ImportError:
+    GRAPHVIZ_INSTALLED = False

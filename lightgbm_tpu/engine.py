@@ -1,0 +1,348 @@
+"""Training and cross-validation drivers (python-package/lightgbm/engine.py).
+
+``train()`` mirrors engine.py:18-270: parameter normalization, callback
+ordering (before/after iteration), early stopping via EarlyStopException,
+evals_result recording.  ``cv()`` mirrors engine.py:375-580 with
+group-aware / stratified / random folds and mean-stdv aggregation.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback
+from .basic import Booster, Dataset, LightGBMError
+from .config import alias_transform
+from .utils.log import Log
+
+__all__ = ["train", "cv", "CVBooster"]
+
+_NUM_BOOST_ROUND_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
+                            "n_iter", "num_tree", "num_trees", "num_round",
+                            "num_rounds", "n_estimators")
+_EARLY_STOP_ALIASES = ("early_stopping_round", "early_stopping_rounds",
+                       "early_stopping", "n_iter_no_change")
+
+
+def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None, verbose_eval=True,
+          learning_rates=None, keep_training_booster: bool = False,
+          callbacks=None) -> Booster:
+    """Train with given parameters; returns the trained Booster."""
+    params = copy.deepcopy(params) if params else {}
+    for alias in _NUM_BOOST_ROUND_ALIASES:
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+            Log.warning("Found `%s` in params. Will use it instead of argument",
+                        alias)
+    for alias in _EARLY_STOP_ALIASES:
+        if alias in params:
+            early_stopping_rounds = int(params.pop(alias))
+            Log.warning("Found `%s` in params. Will use it instead of argument",
+                        alias)
+    first_metric_only = bool(params.get("first_metric_only", False))
+    params.pop("first_metric_only", None)
+
+    if fobj is not None:
+        params["objective"] = "none"
+    if num_boost_round <= 0:
+        raise ValueError("num_boost_round should be greater than zero.")
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+    params["num_iterations"] = num_boost_round
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        if isinstance(init_model, str):
+            with open(init_model) as fh:
+                model_str = fh.read()
+        elif isinstance(init_model, Booster):
+            model_str = init_model.model_to_string()
+        else:
+            raise TypeError("init_model should be a path or a Booster")
+        booster._booster.load_model_from_string(model_str)
+        booster._booster.reset_training_data(train_set.handle,
+                                             booster._booster.objective)
+        # replay the loaded model onto the training scores
+        for i, tree in enumerate(booster._booster.models):
+            booster._booster._add_tree_score_train(
+                tree, i % booster._booster.num_tree_per_iteration)
+    init_iteration = booster._booster.num_init_iteration
+
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if valid_names is None:
+            valid_names = []
+        elif isinstance(valid_names, str):
+            valid_names = [valid_names]
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                continue
+            name = valid_names[i] if i < len(valid_names) else "valid_%d" % i
+            if vs.reference is None:
+                vs.set_reference(train_set)
+            booster.add_valid(vs, name)
+    is_valid_contain_train = valid_sets is not None and any(
+        vs is train_set for vs in (valid_sets or []))
+    train_data_name = "training"
+    if is_valid_contain_train and valid_names:
+        idx = [i for i, vs in enumerate(valid_sets) if vs is train_set]
+        if idx and idx[0] < len(valid_names):
+            train_data_name = valid_names[idx[0]]
+
+    callbacks = set() if callbacks is None else set(callbacks)
+    if verbose_eval is True:
+        callbacks.add(callback.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval > 0:
+        callbacks.add(callback.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.add(callback.early_stopping(
+            early_stopping_rounds, first_metric_only,
+            verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        callbacks.add(callback.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        callbacks.add(callback.record_evaluation(evals_result))
+
+    callbacks_before_iter = {cb for cb in callbacks
+                             if getattr(cb, "before_iteration", False)}
+    callbacks_after_iter = callbacks - callbacks_before_iter
+    callbacks_before_iter = sorted(callbacks_before_iter,
+                                   key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after_iter = sorted(callbacks_after_iter,
+                                  key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in callbacks_before_iter:
+            cb(callback.CallbackEnv(model=booster, params=params, iteration=i,
+                                    begin_iteration=init_iteration,
+                                    end_iteration=init_iteration + num_boost_round,
+                                    evaluation_result_list=None))
+        finished = booster.update(fobj=fobj)
+        evaluation_result_list = []
+        if valid_sets is not None or booster._booster.train_metrics:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(
+                    [(train_data_name, m, v, h)
+                     for (_, m, v, h) in booster.eval_train(feval)])
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after_iter:
+                cb(callback.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=init_iteration,
+                    end_iteration=init_iteration + num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback.EarlyStopException as earlyStopException:
+            booster.best_iteration = earlyStopException.best_iteration + 1
+            evaluation_result_list = earlyStopException.best_score
+            break
+        if finished:
+            break
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for data_name, eval_name, e_val, _ in (evaluation_result_list or []):
+        booster.best_score[data_name][eval_name] = e_val
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (engine.py:277 _CVBooster)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold, params, seed,
+                  fpreproc=None, stratified=True, shuffle=True,
+                  eval_train_metric=False):
+    full_data = full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError("folds should be a generator or iterator of "
+                                 "(train_idx, test_idx) tuples or scikit-learn "
+                                 "splitter object with split method")
+        if hasattr(folds, "split"):
+            group_info = full_data.get_group()
+            if group_info is not None:
+                group_info = np.asarray(group_info, dtype=np.int32)
+                flatted_group = np.repeat(range(len(group_info)),
+                                          repeats=group_info)
+            else:
+                flatted_group = np.zeros(num_data, dtype=np.int32)
+            folds = folds.split(X=np.empty(num_data),
+                                y=full_data.get_label(),
+                                groups=flatted_group)
+    else:
+        if any(params.get(name) in {"lambdarank", "rank_xendcg"}
+               for name in ("objective", "application")):
+            # group-aware fold split (engine.py:313)
+            group_info = np.asarray(full_data.get_group(), dtype=np.int32)
+            num_group = len(group_info)
+            group_kfold = _LGBMGroupKFold(n_splits=nfold)
+            flatted_group = np.repeat(range(num_group), repeats=group_info)
+            folds = group_kfold.split(np.empty(num_data), groups=flatted_group)
+        elif stratified:
+            labels = np.asarray(full_data.get_label())
+            order = np.argsort(labels, kind="stable")
+            folds_idx = [order[i::nfold] for i in range(nfold)]
+            folds = [(np.setdiff1d(np.arange(num_data), fi), np.sort(fi))
+                     for fi in folds_idx]
+        else:
+            if shuffle:
+                randidx = np.random.RandomState(seed).permutation(num_data)
+            else:
+                randidx = np.arange(num_data)
+            kstep = int(num_data / nfold)
+            test_id = [randidx[i:i + kstep] for i in range(0, num_data, kstep)
+                       ][:nfold]
+            folds = [(np.setdiff1d(randidx, ti), np.sort(ti)) for ti in test_id]
+
+    ret = CVBooster()
+    for train_idx, test_idx in folds:
+        train_subset = full_data.subset(sorted(train_idx))
+        valid_subset = full_data.subset(sorted(test_idx))
+        if fpreproc is not None:
+            train_subset, valid_subset, tparam = fpreproc(
+                train_subset, valid_subset, params.copy())
+        else:
+            tparam = params
+        cvbooster = Booster(tparam, train_subset)
+        if eval_train_metric:
+            cvbooster.add_valid(train_subset, "train")
+        cvbooster.add_valid(valid_subset, "valid")
+        ret._append(cvbooster)
+    return ret
+
+
+class _LGBMGroupKFold:
+    """Minimal GroupKFold (sklearn-compatible subset) for ranking cv."""
+
+    def __init__(self, n_splits=5):
+        self.n_splits = n_splits
+
+    def split(self, X, y=None, groups=None):
+        groups = np.asarray(groups)
+        unique = np.unique(groups)
+        for i in range(self.n_splits):
+            test_groups = unique[i::self.n_splits]
+            test_mask = np.isin(groups, test_groups)
+            yield np.where(~test_mask)[0], np.where(test_mask)[0]
+
+
+def _agg_cv_result(raw_results, eval_train_metric=False):
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            if eval_train_metric:
+                key = "%s %s" % (one_line[0], one_line[1])
+            else:
+                key = one_line[1]
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, np.mean(v), metric_type[k], np.std(v))
+            for k, v in cvmap.items()]
+
+
+def cv(params, train_set, num_boost_round=100, folds=None, nfold=5,
+       stratified=True, shuffle=True, metrics=None, fobj=None, feval=None,
+       init_model=None, feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv=True, seed=0, callbacks=None, eval_train_metric=False,
+       return_cvbooster=False):
+    """Cross-validation; returns dict of 'metric-mean'/'metric-stdv' lists."""
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    params = copy.deepcopy(params) if params else {}
+    for alias in _NUM_BOOST_ROUND_ALIASES:
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+    for alias in _EARLY_STOP_ALIASES:
+        if alias in params:
+            early_stopping_rounds = int(params.pop(alias))
+    first_metric_only = bool(params.pop("first_metric_only", False))
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics is not None:
+        params["metric"] = metrics
+    params["num_iterations"] = num_boost_round
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    results = collections.defaultdict(list)
+    cvfolds = _make_n_folds(train_set, folds=folds, nfold=nfold,
+                            params=params, seed=seed, fpreproc=fpreproc,
+                            stratified=stratified, shuffle=shuffle,
+                            eval_train_metric=eval_train_metric)
+
+    callbacks = set() if callbacks is None else set(callbacks)
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.add(callback.early_stopping(early_stopping_rounds,
+                                              first_metric_only, verbose=False))
+    if verbose_eval is True:
+        callbacks.add(callback.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval > 0:
+        callbacks.add(callback.print_evaluation(verbose_eval, show_stdv))
+    callbacks_before_iter = sorted(
+        (cb for cb in callbacks if getattr(cb, "before_iteration", False)),
+        key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after_iter = sorted(
+        (cb for cb in callbacks if not getattr(cb, "before_iteration", False)),
+        key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before_iter:
+            cb(callback.CallbackEnv(model=cvfolds, params=params, iteration=i,
+                                    begin_iteration=0,
+                                    end_iteration=num_boost_round,
+                                    evaluation_result_list=None))
+        for b in cvfolds.boosters:
+            b.update(fobj=fobj)
+        res = _agg_cv_result([b.eval_valid(feval) for b in cvfolds.boosters],
+                             eval_train_metric)
+        for _, key, mean, _, std in res:
+            results[key + "-mean"].append(mean)
+            results[key + "-stdv"].append(std)
+        try:
+            for cb in callbacks_after_iter:
+                cb(callback.CallbackEnv(model=cvfolds, params=params,
+                                        iteration=i, begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=res))
+        except callback.EarlyStopException as earlyStopException:
+            cvfolds.best_iteration = earlyStopException.best_iteration + 1
+            for k in results:
+                results[k] = results[k][:cvfolds.best_iteration]
+            break
+    if return_cvbooster:
+        results["cvbooster"] = cvfolds
+    return dict(results)

@@ -1,0 +1,274 @@
+"""Public API behavioral tests, modeled on the reference's python suite
+(tests/python_package_test/test_engine.py, test_sklearn.py, test_basic.py):
+train real models on synthetic data and assert metric thresholds/invariants.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_binary(n=2000, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 2 + X[:, 1] ** 2 - 1 + rng.normal(scale=0.5, size=n)
+    y = (logit > 0).astype(np.float64)
+    return X, y
+
+
+def make_regression(n=2000, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 3 + np.sin(X[:, 1]) + rng.normal(scale=0.2, size=n)
+    return X, y
+
+
+def test_train_binary_with_valid_and_evals_result():
+    X, y = make_binary()
+    Xt, yt = make_binary(seed=1)
+    train_data = lgb.Dataset(X, label=y)
+    valid_data = lgb.Dataset(Xt, label=yt, reference=train_data)
+    evals_result = {}
+    params = {"objective": "binary", "metric": ["binary_logloss", "auc"],
+              "num_leaves": 15, "verbosity": -1}
+    bst = lgb.train(params, train_data, num_boost_round=30,
+                    valid_sets=[valid_data], valid_names=["valid"],
+                    evals_result=evals_result, verbose_eval=False)
+    assert bst.current_iteration() == 30
+    assert "valid" in evals_result
+    assert len(evals_result["valid"]["binary_logloss"]) == 30
+    assert evals_result["valid"]["auc"][-1] > 0.85
+    assert evals_result["valid"]["binary_logloss"][-1] < \
+        evals_result["valid"]["binary_logloss"][0]
+    preds = bst.predict(Xt)
+    acc = np.mean((preds > 0.5) == yt)
+    assert acc > 0.85
+
+
+def test_early_stopping():
+    X, y = make_binary()
+    Xt, yt = make_binary(seed=1)
+    train_data = lgb.Dataset(X, label=y)
+    valid_data = lgb.Dataset(Xt, label=yt, reference=train_data)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 31, "learning_rate": 0.5, "verbosity": -1}
+    bst = lgb.train(params, train_data, num_boost_round=200,
+                    valid_sets=[valid_data], early_stopping_rounds=5,
+                    verbose_eval=False)
+    assert bst.best_iteration > 0
+    assert bst.current_iteration() < 200
+
+
+def test_regression_and_model_roundtrip(tmp_path):
+    X, y = make_regression()
+    params = {"objective": "regression", "metric": "l2", "num_leaves": 31,
+              "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=25,
+                    verbose_eval=False)
+    pred = bst.predict(X)
+    mse = np.mean((pred - y) ** 2)
+    assert mse < 0.5
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(bst2.predict(X), pred, rtol=1e-5)
+    # model_to_string / model_from_string
+    s = bst.model_to_string()
+    bst3 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst3.predict(X), pred, rtol=1e-5)
+
+
+def test_multiclass():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(1500, 8))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    params = {"objective": "multiclass", "num_class": 3,
+              "metric": "multi_logloss", "num_leaves": 15, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20,
+                    verbose_eval=False)
+    pred = bst.predict(X)
+    assert pred.shape == (1500, 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+    acc = np.mean(np.argmax(pred, axis=1) == y)
+    assert acc > 0.85
+
+
+def test_lambdarank():
+    rng = np.random.RandomState(7)
+    n_q, per_q = 80, 20
+    X = rng.normal(size=(n_q * per_q, 6))
+    rel = np.clip((X[:, 0] + rng.normal(scale=0.5, size=len(X))) > 0.7, 0, 1)
+    y = rel.astype(np.float64) * rng.randint(1, 4, size=len(X)) * rel
+    group = np.full(n_q, per_q)
+    params = {"objective": "lambdarank", "metric": "ndcg", "ndcg_eval_at": [5],
+              "num_leaves": 15, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, group=group)
+    bst = lgb.train(params, ds, num_boost_round=20, verbose_eval=False)
+    assert bst.current_iteration() == 20
+
+
+def test_cv():
+    X, y = make_binary(1000)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 15, "verbosity": -1}
+    ret = lgb.cv(params, lgb.Dataset(X, label=y), num_boost_round=10, nfold=3,
+                 stratified=True, verbose_eval=False)
+    assert "binary_logloss-mean" in ret
+    assert "binary_logloss-stdv" in ret
+    assert len(ret["binary_logloss-mean"]) == 10
+    assert ret["binary_logloss-mean"][-1] < ret["binary_logloss-mean"][0]
+
+
+def test_custom_fobj_feval():
+    X, y = make_regression()
+
+    def l2_obj(preds, dataset):
+        grad = preds - dataset.get_label()
+        hess = np.ones_like(grad)
+        return grad, hess
+
+    def l1_eval(preds, dataset):
+        return "mae", float(np.mean(np.abs(preds - dataset.get_label()))), False
+
+    train_data = lgb.Dataset(X, label=y)
+    evals_result = {}
+    bst = lgb.train({"num_leaves": 15, "verbosity": -1}, train_data,
+                    num_boost_round=20, fobj=l2_obj, feval=l1_eval,
+                    valid_sets=[train_data], valid_names=["train"],
+                    evals_result=evals_result, verbose_eval=False)
+    assert "mae" in evals_result["train"]
+    assert evals_result["train"]["mae"][-1] < evals_result["train"]["mae"][0]
+    # custom objective trains from 0 init score: compare raw predictions
+    pred = bst.predict(X, raw_score=True)
+    assert np.mean((pred - y) ** 2) < np.var(y)
+
+
+def test_pickle_booster():
+    X, y = make_binary(800)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=10,
+                    verbose_eval=False)
+    dumped = pickle.dumps(bst)
+    bst2 = pickle.loads(dumped)
+    np.testing.assert_allclose(bst2.predict(X), bst.predict(X), rtol=1e-6)
+
+
+def test_continued_training():
+    X, y = make_regression()
+    d1 = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    bst1 = lgb.train(params, d1, num_boost_round=10, verbose_eval=False)
+    mse1 = np.mean((bst1.predict(X) - y) ** 2)
+    bst2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10,
+                     init_model=bst1, verbose_eval=False)
+    assert bst2.current_iteration() == 20
+    mse2 = np.mean((bst2.predict(X) - y) ** 2)
+    assert mse2 < mse1
+
+
+def test_pred_leaf():
+    X, y = make_binary(500)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5,
+                    verbose_eval=False)
+    leaves = bst.predict(X, pred_leaf=True)
+    assert leaves.shape == (500, 5)
+    assert leaves.max() < 7
+
+
+def test_pandas_and_categorical():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(3)
+    n = 1200
+    cat = rng.randint(0, 4, size=n)
+    num = rng.normal(size=n)
+    y = (cat == 2).astype(float) * 2 + num + rng.normal(scale=0.1, size=n)
+    df = pd.DataFrame({"c": pd.Categorical.from_codes(cat, ["a", "b", "c", "d"]),
+                       "x": num})
+    ds = lgb.Dataset(df, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=20,
+                    verbose_eval=False)
+    assert bst.feature_name() == ["c", "x"]
+    dfp = pd.DataFrame({"c": pd.Categorical.from_codes(cat, ["a", "b", "c", "d"]),
+                        "x": num})
+    pred = bst.predict(dfp)
+    assert np.mean((pred - y) ** 2) < np.var(y) * 0.5
+
+
+def test_sklearn_classifier():
+    X, y = make_binary()
+    labels = np.where(y > 0, "pos", "neg")
+    clf = lgb.LGBMClassifier(n_estimators=20, num_leaves=15)
+    clf.fit(X, labels)
+    assert set(clf.classes_) == {"pos", "neg"}
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(X), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    pred = clf.predict(X)
+    assert np.mean(pred == labels) > 0.9
+    imp = clf.feature_importances_
+    assert imp.shape == (10,)
+    assert imp[0] > 0
+
+
+def test_sklearn_regressor_and_early_stopping():
+    X, y = make_regression()
+    Xt, yt = make_regression(seed=5)
+    reg = lgb.LGBMRegressor(n_estimators=100, num_leaves=31,
+                            learning_rate=0.2)
+    reg.fit(X, y, eval_set=[(Xt, yt)], eval_metric="l2",
+            early_stopping_rounds=5, verbose=False)
+    assert reg.best_iteration_ > 0
+    pred = reg.predict(Xt)
+    assert np.mean((pred - yt) ** 2) < np.var(yt) * 0.3
+
+
+def test_sklearn_multiclass():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(900, 6))
+    y = np.array(["u", "v", "w"])[
+        ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int))]
+    clf = lgb.LGBMClassifier(n_estimators=15, num_leaves=15)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (900, 3)
+    assert np.mean(clf.predict(X) == y) > 0.8
+
+
+def test_sklearn_ranker():
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(600, 5))
+    y = np.clip(X[:, 0] + rng.normal(scale=0.3, size=600), 0, None)
+    y = np.digitize(y, [0.5, 1.2]).astype(float)
+    group = np.full(30, 20)
+    rk = lgb.LGBMRanker(n_estimators=10, num_leaves=7)
+    rk.fit(X, y, group=group)
+    assert rk.booster_.current_iteration() == 10
+
+
+def test_dataset_save_binary(tmp_path):
+    X, y = make_binary(300)
+    ds = lgb.Dataset(X, label=y)
+    path = str(tmp_path / "data.bin")
+    ds.save_binary(path)
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    loaded = BinnedDataset.load_binary(path)
+    assert loaded.num_data == 300
+    np.testing.assert_array_equal(loaded.binned, ds.handle.binned)
+
+
+def test_reset_parameter_callback():
+    X, y = make_regression()
+    lrs = [0.3] * 5 + [0.1] * 5
+    evals_result = {}
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "metric": "l2", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=10,
+                    valid_sets=[lgb.Dataset(X, label=y)],
+                    callbacks=[lgb.reset_parameter(learning_rate=lrs)],
+                    evals_result=evals_result, verbose_eval=False)
+    assert bst.current_iteration() == 10
