@@ -246,11 +246,25 @@ class GBDT:
                            dtype=np.int32) if self.train_data else \
             tree.split_feature_inner[:ni]
         # recompute bin thresholds from real-valued thresholds so parsed models
-        # (whose text form stores only real thresholds) route identically
+        # (whose text form stores only real thresholds) route identically;
+        # categorical nodes: category-value bitset -> bin bitset
+        W = self.learner.num_bins // 32
         thr_bin = np.zeros(ni, dtype=np.int32)
+        cat_bits = np.zeros((L, W), dtype=np.uint32)
         for node in range(ni):
             m = self.train_data.bin_mappers[int(tree.split_feature[node])]
-            thr_bin[node] = m.value_to_bin(float(tree.threshold[node]))
+            if int(tree.decision_type[node]) & 1:   # categorical
+                ci = int(tree.threshold[node])
+                lo, hi = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+                for w in range(lo, hi):
+                    word = int(tree.cat_threshold[w])
+                    for j in range(32):
+                        if (word >> j) & 1:
+                            b = m.categorical_2_bin.get((w - lo) * 32 + j)
+                            if b is not None:
+                                cat_bits[node, b >> 5] |= np.uint32(1 << (b & 31))
+            else:
+                thr_bin[node] = m.value_to_bin(float(tree.threshold[node]))
         return TreeArrays(
             split_feature=pad(inner, np.int32),
             threshold_bin=pad(thr_bin, np.int32),
@@ -266,6 +280,7 @@ class GBDT:
             leaf_count=padl(tree.leaf_count, np.float32),
             leaf_parent=padl(tree.leaf_parent, np.int32),
             leaf_depth=padl(tree.leaf_depth, np.int32),
+            cat_bitset=jnp.asarray(cat_bits),
             num_leaves=jnp.int32(nl), row_leaf=jnp.zeros((0,), dtype=jnp.int32))
 
     def _add_tree_score_train(self, tree: Tree, class_id: int,

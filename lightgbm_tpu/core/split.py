@@ -47,6 +47,12 @@ class SplitParams(NamedTuple):
     min_data_in_leaf: int = 20
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
+    # categorical (config.h:600-640)
+    max_cat_to_onehot: int = 4
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    min_data_per_group: int = 100
 
 
 class FeatureInfo(NamedTuple):
@@ -58,7 +64,8 @@ class FeatureInfo(NamedTuple):
 
 
 class BestSplit(NamedTuple):
-    """Per-leaf best split candidate (all scalars)."""
+    """Per-leaf best split candidate (scalars + a [W] bin bitset for
+    categorical many-vs-many splits; all-zero for numerical)."""
     gain: jax.Array          # improvement over parent (-inf if none)
     feature: jax.Array       # inner feature index, i32
     threshold: jax.Array     # bin threshold (left: bin <= threshold), i32
@@ -71,6 +78,7 @@ class BestSplit(NamedTuple):
     right_count: jax.Array
     left_output: jax.Array
     right_output: jax.Array
+    cat_bitset: jax.Array    # [B//32] u32; bins going LEFT (categorical only)
 
 
 class FeatureBest(NamedTuple):
@@ -90,6 +98,7 @@ class FeatureBest(NamedTuple):
     right_count: jax.Array
     left_output: jax.Array
     right_output: jax.Array
+    cat_bitset: jax.Array    # [F, B//32] u32
 
 
 def threshold_l1(s, l1):
@@ -248,7 +257,207 @@ def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array
         right_count=pick(right_c0, right_c1),
         left_output=jnp.where(use1, lo1[fidx, feat_thr], lo0[fidx, feat_thr]),
         right_output=jnp.where(use1, ro1[fidx, feat_thr], ro0[fidx, feat_thr]),
+        cat_bitset=jnp.zeros((F, B // 32), dtype=jnp.uint32),
     )
+
+
+def _bits_to_words(bits: jax.Array) -> jax.Array:
+    """[..., B] bool -> [..., B//32] u32 bitset words."""
+    shape = bits.shape[:-1]
+    B = bits.shape[-1]
+    w = bits.reshape(shape + (B // 32, 32)).astype(jnp.uint32)
+    return (w << jnp.arange(32, dtype=jnp.uint32)).sum(axis=-1, dtype=jnp.uint32)
+
+
+def per_feature_best_categorical(hist: jax.Array, feat: FeatureInfo,
+                                 feature_mask: jax.Array, sum_grad: jax.Array,
+                                 sum_hess: jax.Array, num_data: jax.Array,
+                                 params: SplitParams) -> FeatureBest:
+    """Best categorical split of each feature
+    (feature_histogram.hpp:136-304 FindBestThresholdCategorical).
+
+    One-hot mode for features with <= max_cat_to_onehot bins; otherwise the
+    sorted many-vs-many scan: bins with count >= cat_smooth sorted by
+    grad/(hess+cat_smooth), prefix-scanned from both ends up to
+    max_cat_threshold with the min_data_per_group batching.  The serial
+    two-direction scan becomes a vmapped lax.scan over the (small) bin axis.
+    Resulting left-bin sets are returned as bitsets."""
+    F, _, B = hist.shape
+    W = B // 32
+    p = params
+    g = hist[:, 0, :]
+    h = hist[:, 1, :]
+    total_h = sum_hess + 2 * K_EPSILON
+    total_g = sum_grad
+    num_data_f = num_data.astype(jnp.float32)
+    cnt_factor = num_data_f / total_h
+    cnt = jnp.round(h * cnt_factor)
+
+    is_full = feat.missing_type == int(MissingType.NONE)
+    used_bin = feat.num_bin - 1 + is_full.astype(jnp.int32)     # [F]
+    t = jnp.arange(B, dtype=jnp.int32)[None, :]
+    in_range = t < used_bin[:, None]
+
+    gain_shift = leaf_split_gain(total_g, total_h, p.lambda_l1, p.lambda_l2,
+                                 p.max_delta_step)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+    use_onehot = feat.num_bin <= p.max_cat_to_onehot                # [F]
+
+    # ---------- one-hot: category t vs rest (:157-189) ----------
+    other_g = total_g - g
+    other_h = total_h - h - K_EPSILON
+    other_cnt = num_data_f - cnt
+    ok1 = (in_range & (cnt >= p.min_data_in_leaf)
+           & (h >= p.min_sum_hessian_in_leaf)
+           & (other_cnt >= p.min_data_in_leaf)
+           & (other_h >= p.min_sum_hessian_in_leaf))
+    oh_gain, oh_lo, oh_ro = _split_gains(g, h + K_EPSILON, other_g, other_h, p)
+    oh_gain = jnp.where(ok1 & (oh_gain > min_gain_shift), oh_gain, K_MIN_SCORE)
+    oh_t = jnp.argmax(oh_gain, axis=1).astype(jnp.int32)            # first max
+    fidx = jnp.arange(F)
+    oh_best = oh_gain[fidx, oh_t]
+
+    # ---------- sorted many-vs-many (:191-268) ----------
+    l2c = p.lambda_l2 + p.cat_l2
+    valid_sort = in_range & (cnt >= p.cat_smooth)
+    ctr = g / (h + p.cat_smooth)
+    sort_key = jnp.where(valid_sort, ctr, jnp.inf)
+    order = jnp.argsort(sort_key, axis=1, stable=True).astype(jnp.int32)
+    used = valid_sort.sum(axis=1).astype(jnp.int32)                 # [F]
+    max_num_cat = jnp.minimum(p.max_cat_threshold, (used + 1) // 2)
+
+    gs = jnp.take_along_axis(g, order, axis=1)
+    hs = jnp.take_along_axis(h, order, axis=1)
+    cs = jnp.take_along_axis(cnt, order, axis=1)
+
+    def scan_dir(gs_f, hs_f, cs_f, used_f, maxcat_f, backward):
+        def idx(i):
+            return jnp.where(backward, jnp.maximum(used_f - 1 - i, 0), i)
+
+        def step(state, i):
+            sum_lg, sum_lh, left_c, cnt_grp, stop, bgain, bi = state
+            j = idx(i)
+            active = (i < used_f) & (i < maxcat_f) & ~stop
+            af = active.astype(jnp.float32)
+            sum_lg = sum_lg + gs_f[j] * af
+            sum_lh = sum_lh + hs_f[j] * af
+            left_c = left_c + cs_f[j] * af
+            cnt_grp = cnt_grp + cs_f[j] * af
+            cont1 = ((left_c < p.min_data_in_leaf)
+                     | (sum_lh < p.min_sum_hessian_in_leaf))
+            right_c = num_data_f - left_c
+            sum_rh = total_h - sum_lh
+            brk = ((right_c < p.min_data_in_leaf)
+                   | (right_c < p.min_data_per_group)
+                   | (sum_rh < p.min_sum_hessian_in_leaf))
+            reached_group = active & ~cont1 & ~brk & \
+                (cnt_grp >= p.min_data_per_group)
+            sum_rg = total_g - sum_lg
+            gain, _, _ = _split_gains_l2(sum_lg, sum_lh, sum_rg, sum_rh, p, l2c)
+            cand = reached_group & (gain > min_gain_shift) & (gain > bgain)
+            bgain = jnp.where(cand, gain, bgain)
+            bi = jnp.where(cand, i, bi)
+            cnt_grp = jnp.where(reached_group, 0.0, cnt_grp)
+            stop = stop | (active & brk)
+            return (sum_lg, sum_lh, left_c, cnt_grp, stop, bgain, bi), None
+
+        init = (jnp.float32(0), jnp.float32(K_EPSILON), jnp.float32(0),
+                jnp.float32(0), jnp.bool_(False), jnp.float32(K_MIN_SCORE),
+                jnp.int32(-1))
+        (slg, slh, lc, cg, st, bgain, bi), _ = jax.lax.scan(
+            step, init, jnp.arange(B, dtype=jnp.int32))
+        return bgain, bi
+
+    vscan = jax.vmap(scan_dir, in_axes=(0, 0, 0, 0, 0, None))
+    fwd_gain, fwd_i = vscan(gs, hs, cs, used, max_num_cat, False)
+    bwd_gain, bwd_i = vscan(gs, hs, cs, used, max_num_cat, True)
+    use_bwd = bwd_gain > fwd_gain                                    # fwd ties
+    so_gain = jnp.where(use_bwd, bwd_gain, fwd_gain)
+    so_i = jnp.where(use_bwd, bwd_i, fwd_i)
+
+    # recompute left sums at the winning prefix (inclusive of position so_i)
+    pos = jnp.arange(B, dtype=jnp.int32)[None, :]
+    in_prefix = jnp.where(use_bwd[:, None],
+                          (pos >= jnp.maximum(used - 1 - so_i, 0)[:, None])
+                          & (pos < used[:, None]),
+                          pos <= so_i[:, None])
+    in_prefix &= so_i[:, None] >= 0
+    so_lg = jnp.sum(jnp.where(in_prefix, gs, 0.0), axis=1)
+    so_lh = jnp.sum(jnp.where(in_prefix, hs, 0.0), axis=1) + K_EPSILON
+    so_lc = jnp.sum(jnp.where(in_prefix, cs, 0.0), axis=1)
+
+    # ---------- combine one-hot / sorted per feature ----------
+    oh = use_onehot
+    cat_gain = jnp.where(oh, oh_best, so_gain)
+    l_g = jnp.where(oh, g[fidx, oh_t], so_lg)
+    l_h = jnp.where(oh, h[fidx, oh_t] + K_EPSILON, so_lh)
+    l_c = jnp.where(oh, cnt[fidx, oh_t], so_lc)
+    eff_l2 = jnp.where(oh, p.lambda_l2, l2c)
+    r_g = total_g - l_g
+    r_h = total_h - l_h
+    r_c = num_data_f - l_c
+    l_out = _leaf_output_l2(l_g, l_h, p, eff_l2)
+    r_out = _leaf_output_l2(r_g, r_h, p, eff_l2)
+
+    # left-bin bitsets: one-hot -> {oh_t}; sorted -> prefix through order
+    bits_oh = t == oh_t[:, None]
+    bits_sorted = jnp.zeros((F, B), dtype=bool)
+    scatter_f = jnp.broadcast_to(fidx[:, None], (F, B)).reshape(-1)
+    bits_sorted = bits_sorted.at[scatter_f, order.reshape(-1)].set(
+        in_prefix.reshape(-1))
+    bits = jnp.where(oh[:, None], bits_oh, bits_sorted)
+
+    found = (cat_gain > K_MIN_SCORE) & feature_mask & feat.is_categorical
+    zero = jnp.zeros((F,), jnp.float32)
+    return FeatureBest(
+        gain=jnp.where(found, cat_gain - min_gain_shift, K_MIN_SCORE),
+        threshold=jnp.where(oh, oh_t, so_i + 1).astype(jnp.int32),
+        default_left=jnp.zeros((F,), bool),
+        left_sum_grad=jnp.where(found, l_g, zero),
+        left_sum_hess=jnp.where(found, l_h - K_EPSILON, zero),
+        left_count=jnp.where(found, l_c, zero),
+        right_sum_grad=jnp.where(found, r_g, zero),
+        right_sum_hess=jnp.where(found, r_h - K_EPSILON, zero),
+        right_count=jnp.where(found, r_c, zero),
+        left_output=l_out,
+        right_output=r_out,
+        cat_bitset=jnp.where(found[:, None], _bits_to_words(bits), 0).astype(
+            jnp.uint32),
+    )
+
+
+def _split_gains_l2(gl, hl, gr, hr, p: SplitParams, l2):
+    lo = _leaf_output_l2(gl, hl, p, l2)
+    ro = _leaf_output_l2(gr, hr, p, l2)
+    gain = (leaf_split_gain_given_output(gl, hl, p.lambda_l1, l2, lo)
+            + leaf_split_gain_given_output(gr, hr, p.lambda_l1, l2, ro))
+    return gain, lo, ro
+
+
+def _leaf_output_l2(sum_grad, sum_hess, p: SplitParams, l2):
+    ret = -threshold_l1(sum_grad, p.lambda_l1) / (sum_hess + l2)
+    if p.max_delta_step > 0.0:
+        ret = jnp.clip(ret, -p.max_delta_step, p.max_delta_step)
+    return ret
+
+
+def per_feature_best_combined(hist: jax.Array, feat: FeatureInfo,
+                              feature_mask: jax.Array, sum_grad: jax.Array,
+                              sum_hess: jax.Array, num_data: jax.Array,
+                              params: SplitParams,
+                              any_categorical: bool = True) -> FeatureBest:
+    """Numerical + categorical per-feature bests merged by feature type."""
+    fb_num = per_feature_best(hist, feat, feature_mask, sum_grad, sum_hess,
+                              num_data, params)
+    if not any_categorical:
+        return fb_num
+    fb_cat = per_feature_best_categorical(hist, feat, feature_mask, sum_grad,
+                                          sum_hess, num_data, params)
+    is_cat = feat.is_categorical
+    merged = [jnp.where(is_cat[(...,) + (None,) * (c.ndim - 1)], c, n)
+              if c.ndim > 1 else jnp.where(is_cat, c, n)
+              for n, c in zip(fb_num, fb_cat)]
+    return FeatureBest(*merged)
 
 
 def reduce_feature_best(fb: FeatureBest, feature_ids: jax.Array) -> BestSplit:
@@ -269,6 +478,7 @@ def reduce_feature_best(fb: FeatureBest, feature_ids: jax.Array) -> BestSplit:
         right_count=fb.right_count[best_f],
         left_output=fb.left_output[best_f],
         right_output=fb.right_output[best_f],
+        cat_bitset=fb.cat_bitset[best_f],
     )
 
 

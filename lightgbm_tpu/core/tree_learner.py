@@ -33,8 +33,8 @@ import numpy as np
 
 from .histogram import build_histogram, build_histogram_bounded, _pad_bins
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
-                    per_feature_best, reduce_feature_best, sync_best,
-                    K_MIN_SCORE)
+                    per_feature_best, per_feature_best_combined,
+                    reduce_feature_best, sync_best, K_MIN_SCORE)
 from .tree import Tree
 from ..io.binning import BinType, MissingType
 from ..io.dataset import BinnedDataset
@@ -83,6 +83,7 @@ class TreeArrays(NamedTuple):
     leaf_count: jax.Array       # [L] f32
     leaf_parent: jax.Array      # [L] i32
     leaf_depth: jax.Array       # [L] i32
+    cat_bitset: jax.Array       # [L, B//32] u32 left-bin sets (categorical)
     num_leaves: jax.Array       # scalar i32
     row_leaf: jax.Array         # [N] i32 final leaf of every row
 
@@ -98,23 +99,35 @@ def _bests_update(bests: BestSplit, idx, new: BestSplit) -> BestSplit:
     return BestSplit(*[f.at[idx].set(n) for f, n in zip(bests, new)])
 
 
-def _route_left(col, threshold, default_left, mt, nb, dbin):
-    """NumericalDecisionInner on binned values (tree.h:262-277)."""
+def _route_left(col, threshold, default_left, mt, nb, dbin,
+                is_cat=None, bitset=None):
+    """Decision on binned values: NumericalDecisionInner (tree.h:262-277) or,
+    for categorical splits, membership of the bin in the left bitset
+    (tree.h:283-331 CategoricalDecisionInner; the NaN bin is never a member,
+    so missing goes right)."""
     is_missing = jnp.where(mt == int(MissingType.NAN), col == nb - 1,
                            jnp.where(mt == int(MissingType.ZERO), col == dbin,
                                      False))
-    return jnp.where(is_missing, default_left, col <= threshold)
+    num_left = jnp.where(is_missing, default_left, col <= threshold)
+    if is_cat is None:
+        return num_left
+    if bitset.ndim == 1:          # one bitset for all rows (tree build)
+        word = bitset[col >> 5]
+    else:                         # per-row bitsets (routing through many nodes)
+        word = jnp.take_along_axis(bitset, (col >> 5)[:, None], axis=1)[:, 0]
+    cat_left = ((word >> (col & 31).astype(jnp.uint32)) & 1) == 1
+    return jnp.where(is_cat, cat_left, num_left)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "params", "num_bins", "use_pallas",
-                     "comm"))
+                     "comm", "has_categorical"))
 def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                num_data: jax.Array, feature_mask: jax.Array, feat: FeatureInfo,
                *, num_leaves: int, max_depth: int, params: SplitParams,
                num_bins: int, use_pallas: bool = False,
-               comm: Comm = Comm()) -> TreeArrays:
+               comm: Comm = Comm(), has_categorical: bool = False) -> TreeArrays:
     """Grow one tree.  grad/hess are pre-masked (bagging/subsample weights applied);
     ``num_data`` is the GLOBAL in-bag row count.
 
@@ -176,20 +189,23 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         window — see histogram_pallas_bounded)."""
         return make_hist(values * mask_b.astype(f32)[:, None])
 
+    def pfb(h_, feat_, mask_, sg, sh, cnt, params_):
+        return per_feature_best_combined(h_, feat_, mask_, sg, sh, cnt, params_,
+                                         any_categorical=has_categorical)
+
     def best_of(h, sg, sh, cnt):
         """Replicated best split from a stored block + GLOBAL leaf sums."""
         if mode in ("serial", "data_psum"):
-            fb = per_feature_best(h, feat, feature_mask, sg, sh, cnt, params)
+            fb = pfb(h, feat, feature_mask, sg, sh, cnt, params)
             return reduce_feature_best(fb, jnp.arange(f, dtype=jnp.int32))
         if mode in ("data_rs", "feature"):
-            fb = per_feature_best(h, feat_c, mask_c, sg, sh, cnt, params)
+            fb = pfb(h, feat_c, mask_c, sg, sh, cnt, params)
             return sync_best(reduce_feature_best(fb, ids_c), ax)
         # voting: elect 2*top_k features globally, aggregate only those
         local = jnp.sum(h[0], axis=-1)          # every row hits one bin of feat 0
         lg, lh = local[0], local[1]
         lcnt = cnt.astype(f32) * lh / (sh + 1e-15)
-        fb_local = per_feature_best(h, feat, feature_mask, lg, lh, lcnt,
-                                    vote_params)
+        fb_local = pfb(h, feat, feature_mask, lg, lh, lcnt, vote_params)
         k = min(comm.top_k, f)
         top_gain, top_ids = jax.lax.top_k(fb_local.gain, k)
         all_ids = jax.lax.all_gather(top_ids, ax).reshape(-1)
@@ -199,8 +215,7 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         elected = jnp.sort(jax.lax.top_k(key, min(2 * k, f))[1]).astype(jnp.int32)
         he = jax.lax.psum(h[elected], ax)
         feat_e = FeatureInfo(*[a[elected] for a in feat])
-        fb = per_feature_best(he, feat_e, feature_mask[elected], sg, sh, cnt,
-                              params)
+        fb = pfb(he, feat_e, feature_mask[elected], sg, sh, cnt, params)
         return reduce_feature_best(fb, elected)
 
     values = jnp.stack([grad, hess], axis=1)
@@ -224,6 +239,7 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         leaf_value=zl(), leaf_weight=zl().at[0].set(sum_h),
         leaf_count=zl().at[0].set(num_data.astype(f32)),
         leaf_parent=jnp.full((L,), -1, dtype=jnp.int32), leaf_depth=zl(jnp.int32),
+        cat_bitset=jnp.zeros((L, B // 32), dtype=jnp.uint32),
         num_leaves=jnp.int32(1), row_leaf=jnp.zeros((n,), dtype=jnp.int32))
 
     hist = jnp.zeros((L,) + hist0.shape, dtype=f32).at[0].set(hist0)
@@ -251,7 +267,9 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             go_left = _route_left(col, thr, b.default_left,
                                   feat.missing_type[feat_id],
                                   feat.num_bin[feat_id],
-                                  feat.default_bin[feat_id])
+                                  feat.default_bin[feat_id],
+                                  is_cat=feat.is_categorical[feat_id],
+                                  bitset=b.cat_bitset)
             in_leaf = t.row_leaf == leaf
             row_leaf = jnp.where(in_leaf & ~go_left, k, t.row_leaf)
 
@@ -304,6 +322,7 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 leaf_parent=t.leaf_parent.at[leaf].set(node).at[k].set(node),
                 leaf_depth=t.leaf_depth.at[k].set(
                     t.leaf_depth[leaf] + 1).at[leaf].add(1),
+                cat_bitset=t.cat_bitset.at[node].set(b.cat_bitset),
                 num_leaves=t.num_leaves + 1,
                 row_leaf=row_leaf)
             return _State(tree=tree_new, hist=hist_new, bests=bests, cont=st.cont)
@@ -331,7 +350,9 @@ def route_binned(bins: jax.Array, tree: TreeArrays, feat: FeatureInfo,
                                   axis=1)[:, 0].astype(jnp.int32)
         go_left = _route_left(col, tree.threshold_bin[nd], tree.default_left[nd],
                               feat.missing_type[f_id], feat.num_bin[f_id],
-                              feat.default_bin[f_id])
+                              feat.default_bin[f_id],
+                              is_cat=feat.is_categorical[f_id],
+                              bitset=tree.cat_bitset[nd])
         nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
         return jnp.where(is_leaf, node, nxt)
 
@@ -353,7 +374,13 @@ class SerialTreeLearner:
             max_delta_step=float(config.max_delta_step),
             min_data_in_leaf=int(config.min_data_in_leaf),
             min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
-            min_gain_to_split=float(config.min_gain_to_split))
+            min_gain_to_split=float(config.min_gain_to_split),
+            max_cat_to_onehot=int(config.max_cat_to_onehot),
+            cat_l2=float(config.cat_l2),
+            cat_smooth=float(config.cat_smooth),
+            max_cat_threshold=int(config.max_cat_threshold),
+            min_data_per_group=int(config.min_data_per_group))
+        self.has_categorical = bool(dataset.feature_is_categorical().any())
         self.num_bins = _pad_bins(dataset.max_num_bin)
         self.use_pallas = jax.default_backend() == "tpu"
         nf = dataset.num_features
@@ -398,7 +425,8 @@ class SerialTreeLearner:
                           feature_mask, self.feat,
                           num_leaves=self.num_leaves, max_depth=self.max_depth,
                           params=self.params, num_bins=self.num_bins,
-                          use_pallas=self.use_pallas)
+                          use_pallas=self.use_pallas,
+                          has_categorical=self.has_categorical)
 
     # ---- host tree construction ----
 
@@ -420,8 +448,29 @@ def tree_from_arrays(arrays: TreeArrays, dataset: BinnedDataset,
         m = mappers[inner]
         t.split_feature_inner[node] = inner
         t.split_feature[node] = dataset.used_feature_idx[inner]
-        t.threshold_in_bin[node] = int(a.threshold_bin[node])
-        t.threshold[node] = m.bin_to_value(int(a.threshold_bin[node]))
+        if m.bin_type == BinType.CATEGORICAL:
+            # device bin-bitset -> category-value bitset
+            # (tree.h:83 SplitCategorical; Common::ConstructBitset)
+            words = np.asarray(a.cat_bitset[node], dtype=np.uint32)
+            bins_set = [b for b in range(words.size * 32)
+                        if (words[b >> 5] >> (b & 31)) & 1]
+            cats = sorted(int(m.bin_2_categorical[b]) for b in bins_set
+                          if b < len(m.bin_2_categorical))
+            nw_in = max(bins_set, default=0) // 32 + 1
+            t.cat_boundaries_inner.append(t.cat_boundaries_inner[-1] + nw_in)
+            t.cat_threshold_inner.extend(int(words[w]) for w in range(nw_in))
+            nw = (max(cats, default=0) // 32) + 1
+            cwords = [0] * nw
+            for c in cats:
+                cwords[c >> 5] |= 1 << (c & 31)
+            t.threshold_in_bin[node] = t.num_cat
+            t.threshold[node] = float(t.num_cat)
+            t.cat_boundaries.append(t.cat_boundaries[-1] + nw)
+            t.cat_threshold.extend(cwords)
+            t.num_cat += 1
+        else:
+            t.threshold_in_bin[node] = int(a.threshold_bin[node])
+            t.threshold[node] = m.bin_to_value(int(a.threshold_bin[node]))
         t.decision_type[node] = Tree.make_decision_type(
             m.bin_type == BinType.CATEGORICAL, bool(a.default_left[node]),
             int(m.missing_type))

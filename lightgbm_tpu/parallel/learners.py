@@ -92,7 +92,8 @@ class _ParallelTreeLearner(SerialTreeLearner):
         fn = functools.partial(
             build_tree, num_leaves=self.num_leaves, max_depth=self.max_depth,
             params=self.params, num_bins=self.num_bins,
-            use_pallas=self.use_pallas, comm=self.comm)
+            use_pallas=self.use_pallas, comm=self.comm,
+            has_categorical=self.has_categorical)
         row = P() if self.mode == "feature" else P(self.axis)
         bins_spec = P() if self.mode == "feature" else P(self.axis, None)
         out_specs = TreeArrays(

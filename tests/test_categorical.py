@@ -1,0 +1,109 @@
+"""Categorical split tests (reference: test_engine.py:117-313 categorical
+handling; feature_histogram.hpp:136-304 one-hot and sorted many-vs-many)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def make_cat_problem(n=3000, n_cats=12, seed=0):
+    """Target depends ONLY on the categorical feature (many-vs-many split)."""
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, n_cats, size=n)
+    # categories {0, 3, 7} have high mean
+    hot = np.isin(cat, [0, 3, 7])
+    y = hot * 3.0 + rng.normal(scale=0.2, size=n)
+    X = np.column_stack([cat.astype(np.float64), rng.normal(size=n)])
+    return X, y, hot
+
+
+def test_categorical_split_is_used_and_predicts():
+    X, y, hot = make_cat_problem()
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_per_group": 10,
+                     "cat_smooth": 1.0, "max_cat_to_onehot": 4},
+                    ds, num_boost_round=20, verbose_eval=False)
+    tree0 = bst._booster.models[0]
+    assert tree0.num_cat > 0, "no categorical split was made"
+    assert 0 in set(tree0.split_feature[:tree0.num_leaves - 1])
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.1
+    # unseen / out-of-range category routes right (tree.h:283-331)
+    Xnew = np.array([[99.0, 0.0], [np.nan, 0.0]])
+    p = bst.predict(Xnew)
+    assert np.all(np.isfinite(p))
+
+
+def test_categorical_onehot_mode():
+    """<= max_cat_to_onehot categories: one category vs rest."""
+    rng = np.random.RandomState(1)
+    n = 2000
+    cat = rng.randint(0, 3, size=n)
+    y = (cat == 1) * 2.0 + rng.normal(scale=0.1, size=n)
+    X = cat.astype(np.float64).reshape(-1, 1)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "num_leaves": 4,
+                     "verbosity": -1, "max_cat_to_onehot": 4,
+                     "min_data_per_group": 10, "learning_rate": 0.5},
+                    ds, num_boost_round=20, verbose_eval=False)
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.05
+    means = [pred[cat == k].mean() for k in range(3)]
+    assert means[1] > means[0] + 1.0
+    assert means[1] > means[2] + 1.0
+
+
+def test_categorical_model_roundtrip(tmp_path):
+    X, y, _ = make_cat_problem()
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_per_group": 10,
+                     "cat_smooth": 1.0}, ds, num_boost_round=8,
+                    verbose_eval=False)
+    pred = bst.predict(X)
+    path = str(tmp_path / "cat_model.txt")
+    bst.save_model(path)
+    text = open(path).read()
+    assert "num_cat=" in text and "cat_threshold=" in text
+    bst2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(bst2.predict(X), pred, rtol=1e-6)
+
+
+def test_categorical_valid_set_routing():
+    """Loaded/host trees route categorical splits on a valid set identically."""
+    X, y, _ = make_cat_problem()
+    Xv, yv, _ = make_cat_problem(seed=5)
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    evals = {}
+    bst = lgb.train({"objective": "regression", "metric": "l2",
+                     "num_leaves": 7, "verbosity": -1,
+                     "min_data_per_group": 10, "cat_smooth": 1.0},
+                    train, num_boost_round=15, valid_sets=[valid],
+                    valid_names=["v"], evals_result=evals, verbose_eval=False)
+    # valid-set l2 (device routing) must match host prediction l2
+    host_l2 = float(np.mean((bst.predict(Xv) - yv) ** 2))
+    assert evals["v"]["l2"][-1] == pytest.approx(host_l2, rel=1e-4)
+    assert host_l2 < 0.2
+
+
+def test_pandas_categorical_split():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(3)
+    n = 2000
+    cat = rng.randint(0, 6, size=n)
+    y = np.isin(cat, [1, 4]) * 2.0 + rng.normal(scale=0.1, size=n)
+    df = pd.DataFrame(
+        {"c": pd.Categorical.from_codes(cat, list("abcdef")),
+         "x": rng.normal(size=n)})
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_per_group": 10,
+                     "cat_smooth": 1.0, "learning_rate": 0.3},
+                    lgb.Dataset(df, label=y),
+                    num_boost_round=20, verbose_eval=False)
+    tree0 = bst._booster.models[0]
+    assert tree0.num_cat > 0
+    assert np.mean((bst.predict(df) - y) ** 2) < 0.1
