@@ -1,0 +1,150 @@
+"""Command-line application: ``python -m lightgbm_tpu config=train.conf``.
+
+Counterpart of the reference CLI (src/main.cpp, src/application/application.cpp):
+parameter precedence argv key=val over config-file lines (:49-82), task
+dispatch train/predict/convert_model/refit (:204-260), rank-aware data loading
+(:84-165), per-metric_freq evaluation logging, snapshots, and the
+``LightGBM_predict_result.txt`` output format (predictor.hpp).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .boosting import create_boosting
+from .boosting.gbdt import GBDT
+from .config import Config, parse_config_file
+from .io.loader import DatasetLoader
+from .metric.metric import create_metrics
+from .objective import create_objective
+from .utils.log import Log
+from .utils.timer import global_timer
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """argv ``k=v`` pairs + optional ``config=file`` (application.cpp:49-82);
+    command-line values win over config-file values."""
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            Log.warning("Unknown argument %s", arg)
+            continue
+        k, v = arg.split("=", 1)
+        params[k.strip()] = v.strip()
+    if "config" in params:
+        file_params = parse_config_file(params.pop("config"))
+        for k, v in file_params.items():
+            params.setdefault(k, v)
+    return params
+
+
+class Application:
+    """CLI application (src/application/application.h)."""
+
+    def __init__(self, argv: List[str]) -> None:
+        self.params = parse_args(argv)
+        self.config = Config(self.params)
+        Log.reset_level(Log.level_from_verbosity(int(self.config.verbosity)))
+
+    def run(self) -> None:
+        task = self.config.task
+        if task == "train":
+            self.train()
+        elif task in ("predict", "prediction", "test"):
+            self.predict()
+        elif task == "convert_model":
+            self.convert_model()
+        elif task == "refit":
+            self.refit()
+        else:
+            Log.fatal("Unknown task: %s", task)
+
+    # ---- task=train (application.cpp:84-213) ----
+
+    def train(self) -> None:
+        cfg = self.config
+        loader = DatasetLoader(cfg)
+        num_machines = max(int(cfg.num_machines), 1)
+        rank = 0  # single-host CLI; multi-chip parallelism is in-process
+        train_data = loader.load_from_file(cfg.data, rank, num_machines)
+        Log.info("Finished loading data: %d rows, %d features",
+                 train_data.num_data, train_data.num_features)
+        objective = create_objective(cfg.objective, cfg)
+        booster = create_boosting(cfg.boosting, cfg, train_data, objective)
+        if cfg.input_model:
+            with open(cfg.input_model) as fh:
+                booster.load_model_from_string(fh.read())
+            booster.reset_training_data(train_data, objective)
+            for i, tree in enumerate(booster.models):
+                booster._add_tree_score_train(
+                    tree, i % booster.num_tree_per_iteration)
+        if cfg.is_provide_training_metric:
+            booster.add_train_metrics(create_metrics(cfg.metric, cfg))
+        for i, valid_file in enumerate(cfg.valid or []):
+            valid = loader.load_from_file(valid_file, reference=train_data)
+            booster.add_valid_data(valid, "valid_%d" % (i + 1),
+                                   create_metrics(cfg.metric, cfg))
+        booster.train(snapshot_out=cfg.output_model)
+        booster.save_model(cfg.output_model)
+        if cfg.verbosity > 0:
+            global_timer.print()
+
+    # ---- task=predict (application.cpp:215-252, predictor.hpp) ----
+
+    def predict(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("Need input_model for prediction task")
+        booster = GBDT.load_model(cfg.input_model, cfg)
+        loader = DatasetLoader(cfg)
+        X = loader.load_prediction_data(cfg.data)
+        num_iter = int(cfg.num_iteration_predict)
+        if cfg.predict_leaf_index:
+            out = booster.predict_leaf_index(X, num_iter)
+        elif cfg.predict_contrib:
+            out = booster.predict_contrib(X, num_iter)
+        else:
+            out = booster.predict(X, raw_score=bool(cfg.predict_raw_score),
+                                  num_iteration=num_iter)
+        with open(cfg.output_result, "w") as fh:
+            for row in np.atleast_1d(out):
+                if np.ndim(row) == 0:
+                    fh.write("%g\n" % row)
+                else:
+                    fh.write("\t".join("%g" % v for v in row) + "\n")
+        Log.info("Finished prediction, wrote results to %s", cfg.output_result)
+
+    # ---- task=convert_model (gbdt_model_text.cpp:87 ModelToIfElse) ----
+
+    def convert_model(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("Need input_model for convert_model task")
+        booster = GBDT.load_model(cfg.input_model, cfg)
+        from .model_codegen import model_to_cpp
+        code = model_to_cpp(booster)
+        out = cfg.convert_model or "gbdt_prediction.cpp"
+        with open(out, "w") as fh:
+            fh.write(code)
+        Log.info("Wrote converted model to %s", out)
+
+    # ---- task=refit (gbdt.cpp:299 RefitTree) ----
+
+    def refit(self) -> None:
+        Log.fatal("refit task is not supported yet")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("Usage: python -m lightgbm_tpu config=<config file> [key=value ...]")
+        return 1
+    try:
+        Application(argv).run()
+    except Exception as exc:  # main.cpp:23-41 catch-all
+        Log.warning("Met Exceptions:")
+        Log.warning(str(exc))
+        raise SystemExit(1)
+    return 0

@@ -1,0 +1,163 @@
+"""DatasetLoader: text file -> BinnedDataset with config-driven columns.
+
+Counterpart of ``DatasetLoader`` (src/io/dataset_loader.cpp): header handling
+(SetHeader :31), label/weight/group columns (by index or ``name:`` prefix),
+ignore columns, categorical features, side files (``.weight``/``.query``/
+``.init``, metadata.cpp), rank-aware partitioning for distributed loading
+(LoadFromFile :168), binary round-trip, and validation alignment with the
+training dataset's bin mappers (LoadFromFileAlignWithOtherDataset :230).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import BinnedDataset
+from .parser import parse_file
+from ..utils.log import Log
+
+
+def _parse_column_spec(spec: str, names: Optional[List[str]], what: str) -> int:
+    """'3' -> 3; 'name:foo' -> index of foo (dataset_loader.cpp:40-78)."""
+    if spec == "":
+        return -1
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if names is None or name not in names:
+            Log.fatal("Could not find %s column %s in data file", what, name)
+        return names.index(name)
+    return int(spec)
+
+
+def _parse_multi_column_spec(spec, names: Optional[List[str]]) -> List[int]:
+    if spec in ("", None):
+        return []
+    if isinstance(spec, (list, tuple)):
+        return [int(v) for v in spec]
+    spec = str(spec)
+    if spec.startswith("name:"):
+        wanted = spec[5:].split(",")
+        if names is None:
+            Log.fatal("Cannot use name-based columns without a file header")
+        return [names.index(w) for w in wanted if w in names]
+    return [int(v) for v in spec.split(",") if v != ""]
+
+
+class DatasetLoader:
+    """Config-driven text/binary loading (include/LightGBM/dataset_loader.h)."""
+
+    def __init__(self, config) -> None:
+        self.config = config
+
+    def load_from_file(self, filename: str, rank: int = 0,
+                       num_machines: int = 1,
+                       reference: Optional[BinnedDataset] = None
+                       ) -> BinnedDataset:
+        cfg = self.config
+        if not os.path.exists(filename):
+            Log.fatal("Data file %s does not exist", filename)
+        if _is_binary_file(filename):
+            ds = BinnedDataset.load_binary(filename)
+            return ds
+        header = bool(cfg.header) if cfg.header else None
+        # column specs are indices into the FULL file (label included)
+        feats, label, names = parse_file(filename, header=header, label_idx=-1)
+        label_idx = _parse_column_spec(str(cfg.label_column) or "0", names,
+                                       "label")
+        if label_idx < 0:
+            label_idx = 0
+        weight_idx = _parse_column_spec(str(cfg.weight_column), names, "weight")
+        group_idx = _parse_column_spec(str(cfg.group_column), names, "group")
+        ignore = set(_parse_multi_column_spec(cfg.ignore_column, names))
+
+        label = feats[:, label_idx]
+        weight = feats[:, weight_idx] if weight_idx >= 0 else None
+        group_col = feats[:, group_idx] if group_idx >= 0 else None
+        drop = {label_idx} | ignore
+        if weight_idx >= 0:
+            drop.add(weight_idx)
+        if group_idx >= 0:
+            drop.add(group_idx)
+        keep = [i for i in range(feats.shape[1]) if i not in drop]
+        mat = feats[:, keep]
+        feat_names = ([names[i] for i in keep] if names is not None else None)
+
+        # distributed loading: contiguous stripe per rank
+        # (dataset_loader.cpp:168 pre_partition / sampled partitioning)
+        if num_machines > 1 and self.config.pre_partition is False:
+            n = len(mat)
+            begin = n * rank // num_machines
+            end = n * (rank + 1) // num_machines
+            mat = mat[begin:end]
+            label = label[begin:end]
+            weight = weight[begin:end] if weight is not None else None
+            group_col = group_col[begin:end] if group_col is not None else None
+
+        weight_file = filename + ".weight"
+        if weight is None and os.path.exists(weight_file):
+            weight = np.loadtxt(weight_file, dtype=np.float64, ndmin=1)
+            Log.info("Reading weights from %s", weight_file)
+        group = None
+        query_file = filename + ".query"
+        if group_col is not None:
+            # per-row query ids -> group sizes (metadata.h qids)
+            _, counts = np.unique(group_col, return_counts=True)
+            group = counts.astype(np.int32)
+        elif os.path.exists(query_file):
+            group = np.loadtxt(query_file, dtype=np.int32, ndmin=1)
+            Log.info("Reading query boundaries from %s", query_file)
+        init_score = None
+        init_file = filename + ".init"
+        if os.path.exists(init_file):
+            init_score = np.loadtxt(init_file, dtype=np.float64, ndmin=1)
+            Log.info("Reading initial scores from %s", init_file)
+
+        categorical = _parse_multi_column_spec(cfg.categorical_feature,
+                                               feat_names)
+        forced_bins = None
+        if getattr(cfg, "forcedbins_filename", ""):
+            forced_bins = _load_forced_bins(cfg.forcedbins_filename)
+        ds = BinnedDataset.from_matrix(
+            mat, label=label, weight=weight, group=group,
+            init_score=init_score, max_bin=int(cfg.max_bin),
+            min_data_in_bin=int(cfg.min_data_in_bin),
+            min_data_in_leaf=int(cfg.min_data_in_leaf),
+            bin_construct_sample_cnt=int(cfg.bin_construct_sample_cnt),
+            categorical_feature=categorical,
+            use_missing=bool(cfg.use_missing),
+            zero_as_missing=bool(cfg.zero_as_missing),
+            data_random_seed=int(cfg.data_random_seed),
+            feature_names=feat_names, forced_bins=forced_bins,
+            reference=reference)
+        if cfg.save_binary:
+            ds.save_binary(filename + ".bin")
+        return ds
+
+    def load_prediction_data(self, filename: str):
+        """Features (+names) for task=predict; label column dropped if
+        configured (predictor.hpp: parser keeps row shape, label ignored)."""
+        cfg = self.config
+        header = bool(cfg.header) if cfg.header else None
+        feats, _, names = parse_file(filename, header=header, label_idx=-1)
+        label_idx = _parse_column_spec(str(cfg.label_column) or "0", names,
+                                       "label")
+        if 0 <= label_idx < feats.shape[1]:
+            feats = np.delete(feats, label_idx, axis=1)
+        return feats
+
+
+def _is_binary_file(path: str) -> bool:
+    with open(path, "rb") as fh:
+        return fh.read(8) == BinnedDataset.MAGIC
+
+
+def _load_forced_bins(path: str):
+    import json
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        data = json.load(fh)
+    return {int(e["feature"]): list(map(float, e["bin_upper_bound"]))
+            for e in data}

@@ -1,0 +1,141 @@
+"""Text parsers: CSV/TSV/LibSVM with format auto-detection.
+
+Counterpart of the reference ``Parser::CreateParser`` (src/io/parser.cpp:1-222):
+sniff a few lines, pick the format, parse to a dense float64 matrix.  The hot
+path uses pandas' C reader when available (the reference's C++ tokenizer role);
+LibSVM is parsed directly.
+"""
+from __future__ import annotations
+
+import io
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+
+def _sniff_lines(path: str, k: int = 32) -> List[str]:
+    lines = []
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.strip("\r\n")
+            if line:
+                lines.append(line)
+            if len(lines) >= k:
+                break
+    return lines
+
+
+def _is_libsvm_token(tok: str) -> bool:
+    if ":" not in tok:
+        return False
+    a, b = tok.split(":", 1)
+    try:
+        int(a)
+        float(b)
+        return True
+    except ValueError:
+        return False
+
+
+def detect_format(path: str) -> Tuple[str, str]:
+    """Return (format, separator): format in {csv, tsv, libsvm}."""
+    lines = _sniff_lines(path)
+    if not lines:
+        Log.fatal("Data file %s is empty", path)
+    probe = lines[1] if len(lines) > 1 else lines[0]
+    for sep, name in (("\t", "tsv"), (",", "csv"), (" ", "tsv")):
+        if sep in probe:
+            toks = probe.split(sep)
+            if len(toks) > 1:
+                if any(_is_libsvm_token(t) for t in toks[1:3]):
+                    return "libsvm", " "
+                return name, sep
+    if _is_libsvm_token(probe.split(" ")[-1]):
+        return "libsvm", " "
+    return "tsv", "\t"
+
+
+def _has_header(first_line: str, sep: str) -> bool:
+    for tok in first_line.split(sep):
+        tok = tok.strip()
+        if not tok:
+            continue
+        try:
+            float(tok)
+            return False
+        except ValueError:
+            return True
+    return False
+
+
+def parse_file(path: str, header: Optional[bool] = None,
+               label_idx: int = 0
+               ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
+    """Parse a data file -> (features [N, D], labels [N], column names).
+
+    ``label_idx`` < 0 means no label column in the file.  For LibSVM the
+    leading target is the label; feature indices are taken as 0-based columns
+    (reference parses both but defaults to the file's own indexing).
+    """
+    fmt, sep = detect_format(path)
+    if fmt == "libsvm":
+        return _parse_libsvm(path, label_idx)
+    lines = _sniff_lines(path, 1)
+    hdr = _has_header(lines[0], sep) if header is None else header
+    names = None
+    try:
+        import pandas as pd
+        df = pd.read_csv(path, sep=sep, header=0 if hdr else None,
+                         dtype=np.float64 if not hdr else None,
+                         na_values=["", "NA", "N/A", "nan", "NaN", "null"])
+        if hdr:
+            names = [str(c) for c in df.columns]
+        mat = df.to_numpy(dtype=np.float64)
+    except ImportError:
+        skip = 1 if hdr else 0
+        if hdr:
+            names = lines[0].split(sep)
+        mat = np.loadtxt(path, delimiter=sep if sep != " " else None,
+                         skiprows=skip, dtype=np.float64, ndmin=2)
+    if label_idx < 0:
+        return mat, np.zeros(len(mat)), names
+    label = mat[:, label_idx].copy()
+    feats = np.delete(mat, label_idx, axis=1)
+    if names is not None:
+        names = [n for i, n in enumerate(names) if i != label_idx]
+    return feats, label, names
+
+
+def _parse_libsvm(path: str, label_idx: int
+                  ) -> Tuple[np.ndarray, np.ndarray, None]:
+    labels: List[float] = []
+    rows: List[List[Tuple[int, float]]] = []
+    max_idx = -1
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            toks = line.split()
+            start = 0
+            lab = 0.0
+            if label_idx >= 0 and toks and ":" not in toks[0]:
+                lab = float(toks[0])
+                start = 1
+            pairs = []
+            for tok in toks[start:]:
+                if ":" not in tok:
+                    continue
+                i, v = tok.split(":", 1)
+                i = int(i)
+                pairs.append((i, float(v)))
+                max_idx = max(max_idx, i)
+            labels.append(lab)
+            rows.append(pairs)
+    mat = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for r, pairs in enumerate(rows):
+        for i, v in pairs:
+            mat[r, i] = v
+    return mat, np.asarray(labels), None

@@ -1,0 +1,161 @@
+"""CLI + loader tests.
+
+Mirrors the reference's cross-interface consistency strategy
+(tests/python_package_test/test_consistency.py: CLI == Python predictions) and
+the model->C++ codegen equivalence CI task (.ci/test.sh:62-69).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application, parse_args
+from lightgbm_tpu.io.loader import DatasetLoader
+from lightgbm_tpu.io.parser import detect_format, parse_file
+from lightgbm_tpu.config import Config
+
+
+def write_tsv(path, X, y):
+    with open(path, "w") as fh:
+        for row, lab in zip(X, y):
+            fh.write("%g\t" % lab + "\t".join("%g" % v for v in row) + "\n")
+
+
+@pytest.fixture(scope="module")
+def data_files(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cli")
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(1500, 8))
+    logit = X[:, 0] * 2 + X[:, 1] ** 2 - 1
+    y = (logit + rng.normal(scale=0.5, size=1500) > 0).astype(float)
+    train, test = str(tmp / "data.train"), str(tmp / "data.test")
+    write_tsv(train, X[:1200], y[:1200])
+    write_tsv(test, X[1200:], y[1200:])
+    return tmp, train, test, X, y
+
+
+def test_parser_detect_and_parse(tmp_path):
+    X = np.arange(12, dtype=float).reshape(4, 3)
+    y = np.arange(4, dtype=float)
+    tsv = str(tmp_path / "a.tsv")
+    write_tsv(tsv, X, y)
+    assert detect_format(tsv)[0] == "tsv"
+    feats, label, names = parse_file(tsv, label_idx=0)
+    np.testing.assert_array_equal(label, y)
+    np.testing.assert_array_equal(feats, X)
+    # csv with header
+    csv = str(tmp_path / "a.csv")
+    with open(csv, "w") as fh:
+        fh.write("lab,f1,f2,f3\n")
+        for row, lab in zip(X, y):
+            fh.write("%g," % lab + ",".join("%g" % v for v in row) + "\n")
+    feats, label, names = parse_file(csv, label_idx=0)
+    assert names == ["f1", "f2", "f3"]
+    np.testing.assert_array_equal(feats, X)
+    # libsvm
+    svm = str(tmp_path / "a.svm")
+    with open(svm, "w") as fh:
+        fh.write("1 0:0.5 2:1.5\n0 1:2.0\n")
+    assert detect_format(svm)[0] == "libsvm"
+    feats, label, _ = parse_file(svm)
+    np.testing.assert_array_equal(label, [1, 0])
+    np.testing.assert_array_equal(feats, [[0.5, 0, 1.5], [0, 2.0, 0]])
+
+
+def test_loader_side_files(tmp_path):
+    X = np.random.RandomState(1).normal(size=(100, 3))
+    y = np.zeros(100)
+    path = str(tmp_path / "d.train")
+    write_tsv(path, X, y)
+    np.savetxt(path + ".weight", np.full(100, 2.0))
+    np.savetxt(path + ".query", np.full(10, 10), fmt="%d")
+    ds = DatasetLoader(Config()).load_from_file(path)
+    assert ds.num_data == 100
+    assert ds.metadata.weights is not None
+    assert ds.metadata.query_boundaries is not None
+    assert len(ds.metadata.query_boundaries) == 11
+
+
+def test_cli_train_predict_matches_python(data_files):
+    tmp, train, test, X, y = data_files
+    model = str(tmp / "model.txt")
+    out = str(tmp / "preds.txt")
+    Application(["task=train", "data=%s" % train, "objective=binary",
+                 "num_trees=20", "num_leaves=15", "output_model=%s" % model,
+                 "verbosity=-1", "metric=binary_logloss"]).run()
+    assert os.path.exists(model)
+    Application(["task=predict", "data=%s" % test, "input_model=%s" % model,
+                 "output_result=%s" % out, "verbosity=-1"]).run()
+    cli_preds = np.loadtxt(out)
+    assert len(cli_preds) == 300
+
+    # python API predictions through the saved model must agree exactly
+    bst = lgb.Booster(model_file=model)
+    feats, _, _ = parse_file(test, label_idx=0)
+    py_preds = bst.predict(feats)
+    np.testing.assert_allclose(cli_preds, py_preds, rtol=1e-5)
+    acc = np.mean((cli_preds > 0.5) == y[1200:])
+    assert acc > 0.8
+
+
+def test_cli_with_config_file(data_files):
+    tmp, train, test, X, y = data_files
+    model = str(tmp / "model2.txt")
+    conf = str(tmp / "train.conf")
+    with open(conf, "w") as fh:
+        fh.write("task = train\nobjective = binary\ndata = %s\n"
+                 "num_trees = 5\nnum_leaves = 7\noutput_model = %s\n"
+                 "verbosity = -1\n" % (train, model))
+    Application(["config=%s" % conf]).run()
+    assert os.path.exists(model)
+    # CLI key=val overrides config file
+    params = parse_args(["config=%s" % conf, "num_trees=3"])
+    assert params["num_trees"] == "3"
+
+
+def test_cli_main_module(data_files):
+    tmp, train, test, X, y = data_files
+    model = str(tmp / "model3.txt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH="/root/repo")
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=train",
+         "data=%s" % train, "objective=binary", "num_trees=3",
+         "num_leaves=7", "output_model=%s" % model, "verbosity=-1"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.exists(model)
+
+
+def test_convert_model_compiles_and_matches(data_files, tmp_path):
+    """Model->C++ codegen: compile with g++ and diff predictions
+    (reference .ci/test.sh if-else task)."""
+    import ctypes
+    tmp, train, test, X, y = data_files
+    model = str(tmp / "model_cg.txt")
+    Application(["task=train", "data=%s" % train, "objective=binary",
+                 "num_trees=5", "num_leaves=15", "output_model=%s" % model,
+                 "verbosity=-1"]).run()
+    cpp = str(tmp_path / "pred.cpp")
+    Application(["task=convert_model", "input_model=%s" % model,
+                 "convert_model=%s" % cpp, "verbosity=-1"]).run()
+    so = str(tmp_path / "pred.so")
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", cpp, "-o", so],
+                   check=True)
+    lib = ctypes.CDLL(so)
+    lib.Predict.argtypes = [ctypes.POINTER(ctypes.c_double),
+                            ctypes.POINTER(ctypes.c_double)]
+    bst = lgb.Booster(model_file=model)
+    feats, _, _ = parse_file(test, label_idx=0)
+    py = bst.predict(feats)
+    out = np.zeros(1)
+    got = []
+    for row in feats[:50]:
+        arr = np.ascontiguousarray(row, dtype=np.float64)
+        lib.Predict(arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        got.append(out[0])
+    np.testing.assert_allclose(got, py[:50], rtol=1e-10)
