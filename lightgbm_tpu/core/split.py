@@ -61,6 +61,7 @@ class FeatureInfo(NamedTuple):
     missing_type: jax.Array  # i32 (MissingType)
     default_bin: jax.Array   # i32
     is_categorical: jax.Array  # bool
+    monotone: jax.Array      # i32 in {-1, 0, +1} (config monotone_constraints)
 
 
 class BestSplit(NamedTuple):
@@ -132,11 +133,15 @@ def _split_gains(gl, hl, gr, hr, p: SplitParams):
 
 def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array,
                      sum_grad: jax.Array, sum_hess: jax.Array,
-                     num_data: jax.Array, params: SplitParams) -> FeatureBest:
+                     num_data: jax.Array, params: SplitParams,
+                     cmin=None, cmax=None) -> FeatureBest:
     """Best numerical split of EACH feature of one leaf (all outputs [F]).
 
     hist: [F, 2, B] f32; feature_mask: [F] bool (feature_fraction);
-    sum_grad/sum_hess/num_data: leaf totals (scalars).
+    sum_grad/sum_hess/num_data: leaf totals (scalars); cmin/cmax: the leaf's
+    monotone-constraint bounds (monotone_constraints.hpp ConstraintEntry) —
+    outputs are clamped into [cmin, cmax] and candidates on monotone features
+    that violate the ordering are discarded (feature_histogram.hpp:468-527).
     """
     F, _, B = hist.shape
     g = hist[:, 0, :]
@@ -213,6 +218,15 @@ def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array
               & (hl >= params.min_sum_hessian_in_leaf)
               & (hr >= params.min_sum_hessian_in_leaf))
         gain, lo, ro = _split_gains(gl, hl, gr, hr, params)
+        if cmin is not None:
+            lo = jnp.clip(lo, cmin, cmax)
+            ro = jnp.clip(ro, cmin, cmax)
+            gain = (leaf_split_gain_given_output(gl, hl, params.lambda_l1,
+                                                 params.lambda_l2, lo)
+                    + leaf_split_gain_given_output(gr, hr, params.lambda_l1,
+                                                   params.lambda_l2, ro))
+            mono = feat.monotone[:, None]
+            ok &= ~(((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro)))
         ok &= gain > min_gain_shift
         return jnp.where(ok, gain, K_MIN_SCORE), lo, ro
 
@@ -272,7 +286,8 @@ def _bits_to_words(bits: jax.Array) -> jax.Array:
 def per_feature_best_categorical(hist: jax.Array, feat: FeatureInfo,
                                  feature_mask: jax.Array, sum_grad: jax.Array,
                                  sum_hess: jax.Array, num_data: jax.Array,
-                                 params: SplitParams) -> FeatureBest:
+                                 params: SplitParams,
+                                 cmin=None, cmax=None) -> FeatureBest:
     """Best categorical split of each feature
     (feature_histogram.hpp:136-304 FindBestThresholdCategorical).
 
@@ -398,6 +413,9 @@ def per_feature_best_categorical(hist: jax.Array, feat: FeatureInfo,
     r_c = num_data_f - l_c
     l_out = _leaf_output_l2(l_g, l_h, p, eff_l2)
     r_out = _leaf_output_l2(r_g, r_h, p, eff_l2)
+    if cmin is not None:
+        l_out = jnp.clip(l_out, cmin, cmax)
+        r_out = jnp.clip(r_out, cmin, cmax)
 
     # left-bin bitsets: one-hot -> {oh_t}; sorted -> prefix through order
     bits_oh = t == oh_t[:, None]
@@ -445,14 +463,16 @@ def per_feature_best_combined(hist: jax.Array, feat: FeatureInfo,
                               feature_mask: jax.Array, sum_grad: jax.Array,
                               sum_hess: jax.Array, num_data: jax.Array,
                               params: SplitParams,
-                              any_categorical: bool = True) -> FeatureBest:
+                              any_categorical: bool = True,
+                              cmin=None, cmax=None) -> FeatureBest:
     """Numerical + categorical per-feature bests merged by feature type."""
     fb_num = per_feature_best(hist, feat, feature_mask, sum_grad, sum_hess,
-                              num_data, params)
+                              num_data, params, cmin, cmax)
     if not any_categorical:
         return fb_num
     fb_cat = per_feature_best_categorical(hist, feat, feature_mask, sum_grad,
-                                          sum_hess, num_data, params)
+                                          sum_hess, num_data, params,
+                                          cmin, cmax)
     is_cat = feat.is_categorical
     merged = [jnp.where(is_cat[(...,) + (None,) * (c.ndim - 1)], c, n)
               if c.ndim > 1 else jnp.where(is_cat, c, n)

@@ -268,6 +268,110 @@ class Tree:
             out["tree_structure"] = node_json(0)
         return out
 
+    # ---- SHAP contributions (tree.h:133 PredictContrib, src/io/tree.cpp
+    # TreeSHAP — the Lundberg & Lee exact tree SHAP algorithm) ----
+
+    def expected_value(self) -> float:
+        nl = self.num_leaves
+        if nl == 1:
+            return float(self.leaf_value[0])
+        total = float(self.leaf_count[:nl].sum())
+        if total <= 0:
+            return float(self.leaf_value[:nl].mean())
+        return float((self.leaf_value[:nl] * self.leaf_count[:nl]).sum() / total)
+
+    def _node_count(self, node: int) -> float:
+        return float(self.leaf_count[~node] if node < 0
+                     else self.internal_count[node])
+
+    def predict_contrib_row(self, x: np.ndarray, phi: np.ndarray) -> None:
+        """Add this tree's SHAP values for one row into phi [num_features+1]."""
+        phi[-1] += self.expected_value()
+        if self.num_leaves == 1:
+            return
+        path = []  # list of [feature_index, zero_fraction, one_fraction, pweight]
+        self._shap_recurse(x, phi, 0, path, 1.0, 1.0, -1)
+
+    @staticmethod
+    def _extend_path(path, pzf, pof, pfi):
+        path = [list(p) for p in path] + [[pfi, pzf, pof,
+                                           1.0 if len(path) == 0 else 0.0]]
+        n = len(path) - 1
+        for i in range(n - 1, -1, -1):
+            path[i + 1][3] += pof * path[i][3] * (i + 1) / (n + 1)
+            path[i][3] = pzf * path[i][3] * (n - i) / (n + 1)
+        return path
+
+    @staticmethod
+    def _unwind_path(path, path_index):
+        n = len(path) - 1
+        ofr = path[path_index][2]
+        zfr = path[path_index][1]
+        next_one_portion = path[n][3]
+        out = [list(p) for p in path]
+        for i in range(n - 1, -1, -1):
+            if ofr != 0:
+                tmp = out[i][3]
+                out[i][3] = next_one_portion * (n + 1) / ((i + 1) * ofr)
+                next_one_portion = tmp - out[i][3] * zfr * (n - i) / (n + 1)
+            else:
+                out[i][3] = out[i][3] * (n + 1) / (zfr * (n - i))
+        out.pop(path_index)
+        for i in range(path_index, len(out)):
+            out[i][0] = path[i + 1][0]
+            out[i][1] = path[i + 1][1]
+            out[i][2] = path[i + 1][2]
+        return out
+
+    @staticmethod
+    def _unwound_path_sum(path, path_index):
+        n = len(path) - 1
+        ofr = path[path_index][2]
+        zfr = path[path_index][1]
+        next_one_portion = path[n][3]
+        total = 0.0
+        for i in range(n - 1, -1, -1):
+            if ofr != 0:
+                tmp = next_one_portion * (n + 1) / ((i + 1) * ofr)
+                total += tmp
+                next_one_portion = path[i][3] - tmp * zfr * ((n - i) / (n + 1))
+            elif zfr != 0:
+                total += (path[i][3] / zfr) / ((n - i) / (n + 1))
+        return total
+
+    def _shap_recurse(self, x, phi, node, parent_path, pzf, pof, pfi):
+        path = self._extend_path(parent_path, pzf, pof, pfi)
+        if node < 0:
+            leaf = ~node
+            for i in range(1, len(path)):
+                w = self._unwound_path_sum(path, i)
+                el = path[i]
+                phi[el[0]] += w * (el[2] - el[1]) * self.leaf_value[leaf]
+            return
+        go_left = bool(self._decide(np.asarray([x[self.split_feature[node]]]),
+                                    node)[0])
+        hot = int(self.left_child[node] if go_left else self.right_child[node])
+        cold = int(self.right_child[node] if go_left else self.left_child[node])
+        hot_zf = self._node_count(hot) / max(self._node_count(node), 1e-300)
+        cold_zf = self._node_count(cold) / max(self._node_count(node), 1e-300)
+        izf, iof = 1.0, 1.0
+        split_f = int(self.split_feature[node])
+        path_index = next((i for i, p in enumerate(path) if p[0] == split_f),
+                          len(path))
+        if path_index != len(path):
+            izf = path[path_index][1]
+            iof = path[path_index][2]
+            path = self._unwind_path(path, path_index)
+        self._shap_recurse(x, phi, hot, path, hot_zf * izf, iof, split_f)
+        self._shap_recurse(x, phi, cold, path, cold_zf * izf, 0.0, split_f)
+
+    def predict_contrib(self, X: np.ndarray, ncol: int) -> np.ndarray:
+        """SHAP values [N, num_features + 1] (last column = expected value)."""
+        out = np.zeros((len(X), ncol), dtype=np.float64)
+        for r in range(len(X)):
+            self.predict_contrib_row(X[r], out[r])
+        return out
+
     # ---- feature importance contributions (boosting.h:229 semantics) ----
 
     def splits_by_feature(self) -> np.ndarray:

@@ -93,6 +93,8 @@ class _State(NamedTuple):
     hist: jax.Array             # [L, F, 2, B]
     bests: BestSplit            # arrays [L]
     cont: jax.Array             # scalar bool
+    cmin: jax.Array             # [L] monotone constraint lower bounds
+    cmax: jax.Array             # [L] upper bounds
 
 
 def _bests_update(bests: BestSplit, idx, new: BestSplit) -> BestSplit:
@@ -122,12 +124,13 @@ def _route_left(col, threshold, default_left, mt, nb, dbin,
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "params", "num_bins", "use_pallas",
-                     "comm", "has_categorical"))
+                     "comm", "has_categorical", "has_monotone"))
 def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                num_data: jax.Array, feature_mask: jax.Array, feat: FeatureInfo,
                *, num_leaves: int, max_depth: int, params: SplitParams,
                num_bins: int, use_pallas: bool = False,
-               comm: Comm = Comm(), has_categorical: bool = False) -> TreeArrays:
+               comm: Comm = Comm(), has_categorical: bool = False,
+               has_monotone: bool = False) -> TreeArrays:
     """Grow one tree.  grad/hess are pre-masked (bagging/subsample weights applied);
     ``num_data`` is the GLOBAL in-bag row count.
 
@@ -189,23 +192,28 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         window — see histogram_pallas_bounded)."""
         return make_hist(values * mask_b.astype(f32)[:, None])
 
-    def pfb(h_, feat_, mask_, sg, sh, cnt, params_):
-        return per_feature_best_combined(h_, feat_, mask_, sg, sh, cnt, params_,
-                                         any_categorical=has_categorical)
+    def pfb(h_, feat_, mask_, sg, sh, cnt, params_, cmn, cmx):
+        return per_feature_best_combined(
+            h_, feat_, mask_, sg, sh, cnt, params_,
+            any_categorical=has_categorical,
+            cmin=cmn if has_monotone else None,
+            cmax=cmx if has_monotone else None)
 
-    def best_of(h, sg, sh, cnt):
-        """Replicated best split from a stored block + GLOBAL leaf sums."""
+    def best_of(h, sg, sh, cnt, cmn, cmx):
+        """Replicated best split from a stored block + GLOBAL leaf sums +
+        the leaf's monotone-constraint bounds."""
         if mode in ("serial", "data_psum"):
-            fb = pfb(h, feat, feature_mask, sg, sh, cnt, params)
+            fb = pfb(h, feat, feature_mask, sg, sh, cnt, params, cmn, cmx)
             return reduce_feature_best(fb, jnp.arange(f, dtype=jnp.int32))
         if mode in ("data_rs", "feature"):
-            fb = pfb(h, feat_c, mask_c, sg, sh, cnt, params)
+            fb = pfb(h, feat_c, mask_c, sg, sh, cnt, params, cmn, cmx)
             return sync_best(reduce_feature_best(fb, ids_c), ax)
         # voting: elect 2*top_k features globally, aggregate only those
         local = jnp.sum(h[0], axis=-1)          # every row hits one bin of feat 0
         lg, lh = local[0], local[1]
         lcnt = cnt.astype(f32) * lh / (sh + 1e-15)
-        fb_local = pfb(h, feat, feature_mask, lg, lh, lcnt, vote_params)
+        fb_local = pfb(h, feat, feature_mask, lg, lh, lcnt, vote_params,
+                       cmn, cmx)
         k = min(comm.top_k, f)
         top_gain, top_ids = jax.lax.top_k(fb_local.gain, k)
         all_ids = jax.lax.all_gather(top_ids, ax).reshape(-1)
@@ -215,7 +223,8 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         elected = jnp.sort(jax.lax.top_k(key, min(2 * k, f))[1]).astype(jnp.int32)
         he = jax.lax.psum(h[elected], ax)
         feat_e = FeatureInfo(*[a[elected] for a in feat])
-        fb = pfb(he, feat_e, feature_mask[elected], sg, sh, cnt, params)
+        fb = pfb(he, feat_e, feature_mask[elected], sg, sh, cnt, params,
+                 cmn, cmx)
         return reduce_feature_best(fb, elected)
 
     values = jnp.stack([grad, hess], axis=1)
@@ -226,7 +235,9 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # root aggregate Allreduce (data_parallel_tree_learner.cpp:99-146)
         sum_g = jax.lax.psum(sum_g, ax)
         sum_h = jax.lax.psum(sum_h, ax)
-    best0 = best_of(hist0, sum_g, sum_h, num_data)
+    no_min = jnp.float32(-np.inf)
+    no_max = jnp.float32(np.inf)
+    best0 = best_of(hist0, sum_g, sum_h, num_data, no_min, no_max)
 
     def zl(dtype=f32):
         return jnp.zeros((L,), dtype=dtype)
@@ -245,7 +256,9 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     hist = jnp.zeros((L,) + hist0.shape, dtype=f32).at[0].set(hist0)
     bests = BestSplit(*[jnp.broadcast_to(x, (L,) + x.shape).astype(x.dtype)
                         for x in best0])
-    state = _State(tree=tree, hist=hist, bests=bests, cont=jnp.bool_(True))
+    state = _State(tree=tree, hist=hist, bests=bests, cont=jnp.bool_(True),
+                   cmin=jnp.full((L,), -np.inf, dtype=f32),
+                   cmax=jnp.full((L,), np.inf, dtype=f32))
 
     vmapped_best = jax.vmap(best_of)
 
@@ -282,11 +295,25 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             hist_right = jnp.where(left_is_smaller, hist_larger, hist_smaller)
             hist_new = st.hist.at[leaf].set(hist_left).at[k].set(hist_right)
 
+            # monotone constraint propagation
+            # (monotone_constraints.hpp UpdateConstraints)
+            pmin, pmax = st.cmin[leaf], st.cmax[leaf]
+            mono_f = feat.monotone[feat_id]
+            is_num = ~feat.is_categorical[feat_id]
+            mid = (b.left_output + b.right_output) * 0.5
+            lmin = jnp.where(is_num & (mono_f < 0), jnp.maximum(pmin, mid), pmin)
+            lmax = jnp.where(is_num & (mono_f > 0), jnp.minimum(pmax, mid), pmax)
+            rmin = jnp.where(is_num & (mono_f > 0), jnp.maximum(pmin, mid), pmin)
+            rmax = jnp.where(is_num & (mono_f < 0), jnp.minimum(pmax, mid), pmax)
+            cmin_new = st.cmin.at[leaf].set(lmin).at[k].set(rmin)
+            cmax_new = st.cmax.at[leaf].set(lmax).at[k].set(rmax)
+
             child_best = vmapped_best(
                 jnp.stack([hist_left, hist_right]),
                 jnp.stack([b.left_sum_grad, b.right_sum_grad]),
                 jnp.stack([b.left_sum_hess, b.right_sum_hess]),
-                jnp.stack([b.left_count, b.right_count]))
+                jnp.stack([b.left_count, b.right_count]),
+                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]))
             bests = _bests_update(st.bests, leaf,
                                   BestSplit(*[x[0] for x in child_best]))
             bests = _bests_update(bests, k, BestSplit(*[x[1] for x in child_best]))
@@ -325,7 +352,8 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 cat_bitset=t.cat_bitset.at[node].set(b.cat_bitset),
                 num_leaves=t.num_leaves + 1,
                 row_leaf=row_leaf)
-            return _State(tree=tree_new, hist=hist_new, bests=bests, cont=st.cont)
+            return _State(tree=tree_new, hist=hist_new, bests=bests,
+                          cont=st.cont, cmin=cmin_new, cmax=cmax_new)
 
         return jax.lax.cond(ok, do_split,
                             lambda s: s._replace(cont=jnp.bool_(False)), st)
@@ -381,6 +409,13 @@ class SerialTreeLearner:
             max_cat_threshold=int(config.max_cat_threshold),
             min_data_per_group=int(config.min_data_per_group))
         self.has_categorical = bool(dataset.feature_is_categorical().any())
+        mono_cfg = list(getattr(config, "monotone_constraints", []) or [])
+        mono = np.zeros(dataset.num_features, dtype=np.int32)
+        for j, orig in enumerate(dataset.used_feature_idx):
+            if orig < len(mono_cfg):
+                mono[j] = int(mono_cfg[orig])
+        self.monotone = mono
+        self.has_monotone = bool((mono != 0).any())
         self.num_bins = _pad_bins(dataset.max_num_bin)
         self.use_pallas = jax.default_backend() == "tpu"
         nf = dataset.num_features
@@ -388,7 +423,8 @@ class SerialTreeLearner:
             num_bin=jnp.asarray(dataset.num_bin_per_feature, dtype=jnp.int32),
             missing_type=jnp.asarray(dataset.missing_types()),
             default_bin=jnp.asarray(dataset.default_bins()),
-            is_categorical=jnp.asarray(dataset.feature_is_categorical()))
+            is_categorical=jnp.asarray(dataset.feature_is_categorical()),
+            monotone=jnp.asarray(self.monotone))
         # rows padded so the Pallas row tile divides N
         self.num_data = dataset.num_data
         self.padded_rows = (-self.num_data) % 1024 if self.use_pallas else 0
@@ -426,7 +462,8 @@ class SerialTreeLearner:
                           num_leaves=self.num_leaves, max_depth=self.max_depth,
                           params=self.params, num_bins=self.num_bins,
                           use_pallas=self.use_pallas,
-                          has_categorical=self.has_categorical)
+                          has_categorical=self.has_categorical,
+                          has_monotone=self.has_monotone)
 
     # ---- host tree construction ----
 

@@ -81,7 +81,8 @@ class _ParallelTreeLearner(SerialTreeLearner):
                     num_bin=pad_with(self.feat.num_bin, 1),
                     missing_type=pad_with(self.feat.missing_type, 0),
                     default_bin=pad_with(self.feat.default_bin, 0),
-                    is_categorical=pad_with(self.feat.is_categorical, False))
+                    is_categorical=pad_with(self.feat.is_categorical, False),
+                    monotone=pad_with(self.feat.monotone, 0))
 
         row_spec = P() if self.mode == "feature" else P(self.axis, None)
         self.bins = jax.device_put(binned, NamedSharding(self.mesh, row_spec))
@@ -93,7 +94,8 @@ class _ParallelTreeLearner(SerialTreeLearner):
             build_tree, num_leaves=self.num_leaves, max_depth=self.max_depth,
             params=self.params, num_bins=self.num_bins,
             use_pallas=self.use_pallas, comm=self.comm,
-            has_categorical=self.has_categorical)
+            has_categorical=self.has_categorical,
+            has_monotone=self.has_monotone)
         row = P() if self.mode == "feature" else P(self.axis)
         bins_spec = P() if self.mode == "feature" else P(self.axis, None)
         out_specs = TreeArrays(
