@@ -157,6 +157,8 @@ class Dataset:
             zero_as_missing=bool(cfg.zero_as_missing),
             data_random_seed=int(cfg.data_random_seed),
             feature_names=names, reference=ref_handle,
+            max_bin_by_feature=(list(cfg.max_bin_by_feature)
+                                if cfg.max_bin_by_feature else None),
             keep_raw=not self.free_raw_data)
         if self.free_raw_data:
             self.data = None

@@ -94,6 +94,9 @@ class GBDT:
     def models(self, value) -> None:
         self._models: List[Tree] = list(value)
         self._pending: Dict[int, Tuple[TreeArrays, float]] = {}
+        # device arrays of trees materialized since the last poll, kept so a
+        # stall trim can still reverse their score contributions
+        self._window: Dict[int, TreeArrays] = {}
         self._nl_handles: List[Tuple[int, int, jax.Array]] = []
         self._last_poll = 0
 
@@ -103,6 +106,7 @@ class GBDT:
         self._pending = {}
         host = jax.device_get([r[0] for r in recs])  # ONE device round-trip
         for i, rec, arr in zip(idxs, recs, host):
+            self._window[i] = rec[0]
             tree = tree_from_arrays(arr, self.train_data, 1.0)
             if abs(rec[1]) > K_EPSILON:
                 tree.add_bias(rec[1])
@@ -133,11 +137,17 @@ class GBDT:
         stalled = sorted(it for it, v in by_iter.items() if max(v) <= 1)
         if not stalled:
             self._nl_handles = []
+            self._window = {}
             return False
         first = stalled[0]
         cut = first_idx[first]
+        trimmed = {i: a for i, a in self._window.items() if i >= cut}
+        trimmed.update((i, a) for i, (a, _) in self._pending.items()
+                       if i >= cut)  # _pending is fresher than _window
         for idx in sorted(i for i in self._pending if i >= cut):
-            arrays, _ = self._pending.pop(idx)
+            self._pending.pop(idx)
+        for idx in sorted(trimmed):
+            arrays = trimmed[idx]
             k = idx % self.num_tree_per_iteration
             self.train_score = self.train_score.at[k].add(
                 -self._gather_tree_output(arrays))
@@ -147,6 +157,7 @@ class GBDT:
                 vs["score"] = vs["score"].at[k].add(-arrays.leaf_value[leaf])
         del self._models[cut:]
         self._nl_handles = []
+        self._window = {}
         self.iter_ = first
         Log.warning("Stopped training because there are no more leaves "
                     "that meet the split requirements")
@@ -571,6 +582,12 @@ class GBDT:
             for vs in self.valid_sets:
                 self._add_tree_score_valid(idx, tree, k, vs)
         del self.models[-self.num_tree_per_iteration:]
+        # drop lazy bookkeeping for the removed indices so a later stall trim
+        # cannot reverse a rolled-back tree's contribution twice
+        cut = len(self._models)
+        self._pending = {i: r for i, r in self._pending.items() if i < cut}
+        self._window = {i: a for i, a in self._window.items() if i < cut}
+        self._nl_handles = [h for h in self._nl_handles if h[1] < cut]
         self.iter_ -= 1
 
     # ---- training driver with internal early stopping (CLI path) ----
@@ -587,7 +604,7 @@ class GBDT:
                     and (it + 1) % self.config.snapshot_freq == 0):
                 path = "%s.snapshot_iter_%d" % (snapshot_out, it + 1)
                 self.save_model(path)
-        if self._pending:
+        if self._nl_handles:
             self._poll_stop()  # trim any trailing stalled iterations
 
     # ---- evaluation ----

@@ -61,7 +61,7 @@ class FeatureInfo(NamedTuple):
     missing_type: jax.Array  # i32 (MissingType)
     default_bin: jax.Array   # i32
     is_categorical: jax.Array  # bool
-    monotone: jax.Array      # i32 in {-1, 0, +1} (config monotone_constraints)
+    monotone: jax.Array = None  # i32 in {-1, 0, +1}; None == unconstrained
 
 
 class BestSplit(NamedTuple):
@@ -217,14 +217,9 @@ def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array
               & (cl >= params.min_data_in_leaf) & (cr >= params.min_data_in_leaf)
               & (hl >= params.min_sum_hessian_in_leaf)
               & (hr >= params.min_sum_hessian_in_leaf))
-        gain, lo, ro = _split_gains(gl, hl, gr, hr, params)
-        if cmin is not None:
-            lo = jnp.clip(lo, cmin, cmax)
-            ro = jnp.clip(ro, cmin, cmax)
-            gain = (leaf_split_gain_given_output(gl, hl, params.lambda_l1,
-                                                 params.lambda_l2, lo)
-                    + leaf_split_gain_given_output(gr, hr, params.lambda_l1,
-                                                   params.lambda_l2, ro))
+        gain, lo, ro = _split_gains_clamped(gl, hl, gr, hr, params,
+                                            params.lambda_l2, cmin, cmax)
+        if cmin is not None and feat.monotone is not None:
             mono = feat.monotone[:, None]
             ok &= ~(((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro)))
         ok &= gain > min_gain_shift
@@ -326,7 +321,8 @@ def per_feature_best_categorical(hist: jax.Array, feat: FeatureInfo,
            & (h >= p.min_sum_hessian_in_leaf)
            & (other_cnt >= p.min_data_in_leaf)
            & (other_h >= p.min_sum_hessian_in_leaf))
-    oh_gain, oh_lo, oh_ro = _split_gains(g, h + K_EPSILON, other_g, other_h, p)
+    oh_gain, oh_lo, oh_ro = _split_gains_clamped(
+        g, h + K_EPSILON, other_g, other_h, p, p.lambda_l2, cmin, cmax)
     oh_gain = jnp.where(ok1 & (oh_gain > min_gain_shift), oh_gain, K_MIN_SCORE)
     oh_t = jnp.argmax(oh_gain, axis=1).astype(jnp.int32)            # first max
     fidx = jnp.arange(F)
@@ -368,7 +364,8 @@ def per_feature_best_categorical(hist: jax.Array, feat: FeatureInfo,
             reached_group = active & ~cont1 & ~brk & \
                 (cnt_grp >= p.min_data_per_group)
             sum_rg = total_g - sum_lg
-            gain, _, _ = _split_gains_l2(sum_lg, sum_lh, sum_rg, sum_rh, p, l2c)
+            gain, _, _ = _split_gains_clamped(sum_lg, sum_lh, sum_rg, sum_rh,
+                                              p, l2c, cmin, cmax)
             cand = reached_group & (gain > min_gain_shift) & (gain > bgain)
             bgain = jnp.where(cand, gain, bgain)
             bi = jnp.where(cand, i, bi)
@@ -447,6 +444,21 @@ def per_feature_best_categorical(hist: jax.Array, feat: FeatureInfo,
 def _split_gains_l2(gl, hl, gr, hr, p: SplitParams, l2):
     lo = _leaf_output_l2(gl, hl, p, l2)
     ro = _leaf_output_l2(gr, hr, p, l2)
+    gain = (leaf_split_gain_given_output(gl, hl, p.lambda_l1, l2, lo)
+            + leaf_split_gain_given_output(gr, hr, p.lambda_l1, l2, ro))
+    return gain, lo, ro
+
+
+def _split_gains_clamped(gl, hl, gr, hr, p: SplitParams, l2, cmin, cmax):
+    """Like _split_gains_l2, but candidate outputs are clamped into the leaf's
+    monotone bounds BEFORE computing gain, matching GetSplitGains going through
+    ConstraintEntry (feature_histogram.hpp:468-527) so candidate ranking under
+    monotone constraints agrees with the reference."""
+    lo = _leaf_output_l2(gl, hl, p, l2)
+    ro = _leaf_output_l2(gr, hr, p, l2)
+    if cmin is not None:
+        lo = jnp.clip(lo, cmin, cmax)
+        ro = jnp.clip(ro, cmin, cmax)
     gain = (leaf_split_gain_given_output(gl, hl, p.lambda_l1, l2, lo)
             + leaf_split_gain_given_output(gr, hr, p.lambda_l1, l2, ro))
     return gain, lo, ro

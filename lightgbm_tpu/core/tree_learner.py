@@ -298,7 +298,10 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # monotone constraint propagation
             # (monotone_constraints.hpp UpdateConstraints)
             pmin, pmax = st.cmin[leaf], st.cmax[leaf]
-            mono_f = feat.monotone[feat_id]
+            if has_monotone and feat.monotone is not None:
+                mono_f = feat.monotone[feat_id]
+            else:
+                mono_f = jnp.int32(0)
             is_num = ~feat.is_categorical[feat_id]
             mid = (b.left_output + b.right_output) * 0.5
             lmin = jnp.where(is_num & (mono_f < 0), jnp.maximum(pmin, mid), pmin)

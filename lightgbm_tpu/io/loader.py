@@ -62,15 +62,33 @@ class DatasetLoader:
             ds = BinnedDataset.load_binary(filename)
             return ds
         header = bool(cfg.header) if cfg.header else None
-        # column specs are indices into the FULL file (label included)
+        # The label spec is an index into the FULL file; every other column spec
+        # (weight/group/ignore/categorical) is in LABEL-EXCLUDED coordinates —
+        # the reference parser renumbers columns after erasing the label
+        # (dataset_loader.cpp:31-130 SetHeader builds name2idx after the erase;
+        # parser.hpp applies offset -1 past the label).
         feats, label, names = parse_file(filename, header=header, label_idx=-1)
         label_idx = _parse_column_spec(str(cfg.label_column) or "0", names,
                                        "label")
         if label_idx < 0:
             label_idx = 0
-        weight_idx = _parse_column_spec(str(cfg.weight_column), names, "weight")
-        group_idx = _parse_column_spec(str(cfg.group_column), names, "group")
-        ignore = set(_parse_multi_column_spec(cfg.ignore_column, names))
+        names_nolabel = (None if names is None else
+                         names[:label_idx] + names[label_idx + 1:])
+
+        def to_full(idx: int) -> int:
+            """label-excluded column index -> full-file column index."""
+            return idx if idx < label_idx else idx + 1
+
+        weight_idx = _parse_column_spec(str(cfg.weight_column), names_nolabel,
+                                        "weight")
+        group_idx = _parse_column_spec(str(cfg.group_column), names_nolabel,
+                                       "group")
+        if weight_idx >= 0:
+            weight_idx = to_full(weight_idx)
+        if group_idx >= 0:
+            group_idx = to_full(group_idx)
+        ignore = {to_full(i) for i in
+                  _parse_multi_column_spec(cfg.ignore_column, names_nolabel)}
 
         label = feats[:, label_idx]
         weight = feats[:, weight_idx] if weight_idx >= 0 else None
@@ -114,8 +132,11 @@ class DatasetLoader:
             init_score = np.loadtxt(init_file, dtype=np.float64, ndmin=1)
             Log.info("Reading initial scores from %s", init_file)
 
-        categorical = _parse_multi_column_spec(cfg.categorical_feature,
-                                               feat_names)
+        # categorical_feature specs are label-excluded column indices too
+        # (SetHeader resolves them against the label-erased name2idx)
+        cat_cols = {to_full(i) for i in _parse_multi_column_spec(
+            cfg.categorical_feature, names_nolabel)}
+        categorical = [j for j, i in enumerate(keep) if i in cat_cols]
         forced_bins = None
         if getattr(cfg, "forcedbins_filename", ""):
             forced_bins = _load_forced_bins(cfg.forcedbins_filename)
@@ -130,6 +151,8 @@ class DatasetLoader:
             zero_as_missing=bool(cfg.zero_as_missing),
             data_random_seed=int(cfg.data_random_seed),
             feature_names=feat_names, forced_bins=forced_bins,
+            max_bin_by_feature=(list(cfg.max_bin_by_feature)
+                                if cfg.max_bin_by_feature else None),
             reference=reference)
         if cfg.save_binary:
             ds.save_binary(filename + ".bin")
