@@ -582,10 +582,10 @@ class GBDT:
             for vs in self.valid_sets:
                 self._add_tree_score_valid(idx, tree, k, vs)
         del self.models[-self.num_tree_per_iteration:]
-        # drop lazy bookkeeping for the removed indices so a later stall trim
-        # cannot reverse a rolled-back tree's contribution twice
+        # the models-property access above emptied _pending (materialization);
+        # drop _window/_nl_handles entries for the removed indices so a later
+        # stall trim cannot reverse a rolled-back tree's contribution twice
         cut = len(self._models)
-        self._pending = {i: r for i, r in self._pending.items() if i < cut}
         self._window = {i: a for i, a in self._window.items() if i < cut}
         self._nl_handles = [h for h in self._nl_handles if h[1] < cut]
         self.iter_ -= 1

@@ -61,6 +61,14 @@ class BinnedDataset:
             Log.fatal("Input data must be 2-dimensional")
         self = cls()
         self.num_data, self.num_total_features = data.shape
+        if max_bin_by_feature:
+            # dataset_loader.cpp:581-586 CHECK_EQ semantics
+            if len(max_bin_by_feature) != self.num_total_features:
+                Log.fatal("Size of max_bin_by_feature (%d) does not match the "
+                          "number of features (%d)", len(max_bin_by_feature),
+                          self.num_total_features)
+            if min(max_bin_by_feature) < 2:
+                Log.fatal("Each entry of max_bin_by_feature must be at least 2")
         self.metadata = Metadata(self.num_data)
         if label is not None:
             self.metadata.set_label(label)
