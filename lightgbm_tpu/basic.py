@@ -466,7 +466,9 @@ class Booster:
             total_iter, start_iteration + num_iteration)
         trees = []
         for i in range(start_iteration * K, end_iter * K):
-            trees.append({"tree_index": i, "tree_structure": b.models[i].to_json()})
+            # reference layout: tree_info[i] = {tree_index, num_leaves,
+            # num_cat, shrinkage, tree_structure} (gbdt_model_text.cpp:20)
+            trees.append({"tree_index": i, **b.models[i].to_json()})
         return {
             "name": b.sub_model_name(),
             "version": "v3",
@@ -483,6 +485,42 @@ class Booster:
                 if v > 0},
             "tree_info": trees,
         }
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of the threshold values used for ``feature`` across all
+        trees (reference basic.py:2693; categorical splits are rejected)."""
+        model = self.dump_model()
+        feature_names = model.get("feature_names")
+        values: List[float] = []
+
+        def walk(node):
+            if "split_index" not in node:
+                return
+            f = node["split_feature"]
+            name = (feature_names[f] if feature_names is not None
+                    and isinstance(feature, str) else f)
+            if name == feature:
+                if node.get("decision_type") == "==":
+                    raise LightGBMError("Cannot compute split value histogram "
+                                        "for the categorical feature")
+                values.append(float(node["threshold"]))
+            walk(node["left_child"])
+            walk(node["right_child"])
+
+        for info in model["tree_info"]:
+            walk(info["tree_structure"])
+        if bins is None or (isinstance(bins, int) and xgboost_style):
+            n_unique = len(np.unique(values))
+            bins = max(min(n_unique, bins) if bins is not None else n_unique, 1)
+        hist, bin_edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((bin_edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            if PANDAS_INSTALLED:
+                return DataFrame(ret, columns=["SplitValue", "Count"])
+            return ret
+        return hist, bin_edges
 
     # ---- introspection ----
 

@@ -248,6 +248,7 @@ class Tree:
                     "default_left": bool(dt & K_DEFAULT_LEFT_MASK),
                     "missing_type": ["None", "Zero", "NaN"][self.missing_type_of(dt)],
                     "internal_value": float(self.internal_value[index]),
+                    "internal_weight": float(self.internal_weight[index]),
                     "internal_count": int(self.internal_count[index]),
                     "left_child": node_json(int(self.left_child[index])),
                     "right_child": node_json(int(self.right_child[index])),

@@ -1,0 +1,93 @@
+"""Plotting tests, modeled on the reference's
+tests/python_package_test/test_plotting.py (5 tests): importance bars, metric
+curves, split-value histogram, tree digraph/rendering."""
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture
+def trained():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(500, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                     "metric": "binary_logloss"}, ds, num_boost_round=10,
+                    valid_sets=[ds], valid_names=["train"],
+                    evals_result=evals, verbose_eval=False)
+    return bst, evals
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax.get_title() == "Feature importance"
+    assert ax.get_xlabel() == "Feature importance"
+    assert len(ax.patches) >= 1
+    ax2 = lgb.plot_importance(bst, importance_type="gain", precision=2,
+                              max_num_features=2, title="t", xlabel="x",
+                              ylabel="y")
+    assert len(ax2.patches) <= 2
+    assert ax2.get_title() == "t"
+    plt.close("all")
+
+
+def test_plot_metric(trained):
+    bst, evals = trained
+    ax = lgb.plot_metric(evals)
+    assert ax.get_ylabel() == "binary_logloss"
+    with pytest.raises(TypeError):
+        lgb.plot_metric(bst)
+    plt.close("all")
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    imp = bst.feature_importance()
+    feat = int(np.argmax(imp))
+    ax = lgb.plot_split_value_histogram(bst, feat)
+    assert "histogram" in ax.get_title()
+    hist, edges = bst.get_split_value_histogram(feat, bins=5)
+    assert hist.sum() > 0
+    assert len(edges) == len(hist) + 1
+    xgb = np.asarray(bst.get_split_value_histogram(feat, xgboost_style=True))
+    assert xgb.ndim == 2 and (xgb[:, 1] > 0).all()
+    plt.close("all")
+
+
+def test_create_tree_digraph(trained):
+    graphviz = pytest.importorskip("graphviz")
+    bst, _ = trained
+    g = lgb.create_tree_digraph(bst, tree_index=1,
+                                show_info=["split_gain", "internal_count",
+                                           "leaf_count"])
+    assert isinstance(g, graphviz.Digraph)
+    src = g.source
+    assert "split" in src and "leaf" in src and "count" in src
+
+
+def test_plot_tree(trained):
+    bst, _ = trained
+    import shutil
+    if shutil.which("dot") is None:
+        pytest.skip("graphviz dot binary not available")
+    ax = lgb.plot_tree(bst, tree_index=0)
+    assert not ax.axison  # image axes
+    plt.close("all")
+
+
+def test_unused_feature_histogram_raises(trained):
+    bst, _ = trained
+    imp = bst.feature_importance()
+    unused = [i for i, v in enumerate(imp) if v == 0]
+    if not unused:
+        pytest.skip("all features used")
+    with pytest.raises(ValueError):
+        lgb.plot_split_value_histogram(bst, unused[0])
