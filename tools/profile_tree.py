@@ -1,0 +1,110 @@
+"""Decompose build_tree cost: t(tree) = L*(a*N + b) + c.
+
+Times whole build_tree calls on the bench shapes at a small (N, L) grid, plus
+a chained histogram-only loop, so we can tell per-split fixed overhead from
+per-row streaming cost.  All timing is wall-clock around a device_get of a
+scalar from the result (the axon tunnel's block_until_ready is unreliable;
+scalar fetch forces completion and costs one round trip, measured first).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.tree_learner import SerialTreeLearner
+from lightgbm_tpu.core.histogram import histogram_pallas
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.utils.log import Log
+
+Log.reset_level(Log.level_from_verbosity(-1))
+F = 28
+MAXBIN = 63
+
+
+def fetch(x):
+    return float(jax.device_get(jnp.ravel(x)[0]))
+
+
+def latency():
+    f = jax.jit(lambda x: x + 1.0)
+    fetch(f(jnp.float32(0)))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        fetch(f(jnp.float32(0)))
+    return (time.perf_counter() - t0) / 5
+
+
+LAT = latency()
+print(f"tunnel latency ~{LAT*1e3:.1f} ms", flush=True)
+
+
+def make_data(n):
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return BinnedDataset.from_matrix(X, label=y, max_bin=MAXBIN)
+
+
+def time_tree(learner, grad, hess, n, reps=3):
+    out = learner.train(grad, hess, n)
+    fetch(out.leaf_value)  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = learner.train(grad, hess, n)
+    fetch(out.leaf_value)
+    return (time.perf_counter() - t0 - LAT) / reps
+
+
+results = {}
+for n in (250_000, 1_000_000):
+    ds = make_data(n)
+    rng = np.random.RandomState(1)
+    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hess = jnp.asarray(np.abs(rng.normal(size=n)).astype(np.float32) + 0.1)
+    for L in (31, 255):
+        cfg = Config(objective="binary", num_leaves=L, max_bin=MAXBIN)
+        learner = SerialTreeLearner(ds, cfg)
+        t = time_tree(learner, grad, hess, n)
+        results[(n, L)] = t
+        print(f"build_tree N={n:>9,} L={L:>3}: {t*1e3:8.1f} ms "
+              f"({t/(L-1)*1e3:6.2f} ms/split)", flush=True)
+
+# fixed-vs-variable decomposition
+a = ((results[(1_000_000, 255)] - results[(250_000, 255)]) / 254
+     - (results[(1_000_000, 31)] - results[(250_000, 31)]) / 30) / 750_000
+b255 = results[(1_000_000, 255)] / 254 - a * 1_000_000 / 1  # rough
+print(f"per-split per-row cost ~{a*1e9:.2f} ns/row; "
+      f"per-split fixed @1M/255 ~{(results[(1_000_000,255)]/254)*1e3:.2f} ms")
+
+# chained histogram-only loop at 1M rows
+n = 1_000_000
+pad = (-n) % 1024
+rng = np.random.RandomState(0)
+bins = jnp.asarray(rng.randint(0, MAXBIN, size=(n + pad, F), dtype=np.uint8))
+vals = jnp.asarray(rng.normal(size=(n + pad, 2)).astype(np.float32))
+REPS = 50
+
+
+@jax.jit
+def hist_chain(v):
+    def body(i, s):
+        v, acc = s
+        h = histogram_pallas(bins, v, 128, row_tile=1024)
+        return v + h[0, 0, 0] * 1e-30, acc + h[0, 0, 0]
+    return jax.lax.fori_loop(0, REPS, body, (v, jnp.float32(0)))
+
+
+out = hist_chain(vals)
+fetch(out[1])
+t0 = time.perf_counter()
+out = hist_chain(vals)
+fetch(out[1])
+t = (time.perf_counter() - t0 - LAT) / REPS
+print(f"histogram_pallas 1M rows (chained x{REPS}): {t*1e3:.2f} ms/pass "
+      f"= {n/t/1e6:.0f} Mrows/s", flush=True)
