@@ -112,6 +112,96 @@ def build_histogram(bins: jax.Array, values: jax.Array, num_bins: int,
     return histogram_xla(bins, values, num_bins)
 
 
+def _hist_kernel_masked(win_ref, bins_ref, vals_ref, out_ref, *,
+                        num_features: int, num_bins: int, row_tile: int):
+    """Histogram of the rows in [win[0], win[0]+win[1]) of its input slice.
+
+    The TPU analogue of the reference's per-leaf ordered-index histogram
+    (dense_bin.hpp:48 ConstructHistogram over ``data_indices`` begin..end):
+    the caller slices a bucket-sized window of the leaf-partitioned matrix,
+    this kernel masks boundary-tile rows outside the leaf's exact window, and
+    tiles fully outside skip compute — cost scales with the leaf's row count,
+    not the dataset size."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    start, count = win_ref[0], win_ref[1]
+    base = i * row_tile
+
+    @pl.when((base < start + count) & (base + row_tile > start))
+    def _accum():
+        rows = base + jax.lax.broadcasted_iota(jnp.int32, (row_tile, 1), 0)
+        in_w = ((rows >= start) & (rows < start + count)).astype(jnp.float32)
+        bins = bins_ref[...].astype(jnp.int32)
+        vals = vals_ref[...] * in_w
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
+        for f in range(num_features):
+            onehot = (bins[:, f:f + 1] == iota).astype(jnp.float32)
+            acc = jax.lax.dot_general(vals, onehot, (((0,), (0,)), ((), ())),
+                                      precision=jax.lax.Precision.HIGHEST,
+                                      preferred_element_type=jnp.float32)
+            out_ref[f, :, :] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile"))
+def histogram_pallas_masked(bins: jax.Array, values: jax.Array, num_bins: int,
+                            start: jax.Array, count: jax.Array,
+                            row_tile: int = 1024) -> jax.Array:
+    """Histogram over rows [start, start+count) of a (bucket-sized) slice.
+
+    bins: [R, F] int; values: [R, 2] f32 (NOT pre-masked); start/count: i32
+    scalars relative to the slice.  R must be a multiple of row_tile."""
+    n, f = bins.shape
+    assert n % row_tile == 0, "pad rows to a multiple of row_tile"
+    win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
+    kernel = functools.partial(_hist_kernel_masked, num_features=f,
+                               num_bins=num_bins, row_tile=row_tile)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // row_tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((row_tile, f), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, 2), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((f, 2, num_bins), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f, 2, num_bins), jnp.float32),
+    )(win, bins, values)
+
+
+def histogram_xla_masked(bins: jax.Array, values: jax.Array, num_bins: int,
+                         start: jax.Array, count: jax.Array) -> jax.Array:
+    """Backend-agnostic masked histogram over a slice (full scan)."""
+    pos = jnp.arange(bins.shape[0], dtype=jnp.int32)
+    in_w = ((pos >= start) & (pos < start + count)).astype(values.dtype)
+    return histogram_xla(bins, values * in_w[:, None], num_bins)
+
+
+def build_histogram_masked(bins: jax.Array, values: jax.Array, num_bins: int,
+                           start: jax.Array, count: jax.Array,
+                           use_pallas: bool | None = None) -> jax.Array:
+    """Masked-histogram dispatch: Pallas on TPU, masked segment-sum off."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas and bins.shape[0] % 1024 == 0:
+        return histogram_pallas_masked(bins, values, num_bins, start, count)
+    return histogram_xla_masked(bins, values, num_bins, start, count)
+
+
+def partition_buckets(n: int, row_tile: int = 1024) -> tuple:
+    """Static window-slice sizes (rows): powers of 4 × row_tile, plus n."""
+    sizes = []
+    b = row_tile
+    while b < n:
+        sizes.append(b)
+        b *= 4
+    sizes.append(n)
+    return tuple(sizes)
+
+
 def _hist_kernel_bounded(cnt_ref, bins_ref, vals_ref, out_ref, *,
                          num_features: int, num_bins: int, row_tile: int):
     @pl.when(pl.program_id(0) == 0)

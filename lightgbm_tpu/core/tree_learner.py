@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import build_histogram, build_histogram_bounded, _pad_bins
+from .histogram import (build_histogram, build_histogram_bounded,
+                        build_histogram_masked, partition_buckets, _pad_bins)
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, per_feature_best_combined,
                     reduce_feature_best, sync_best, K_MIN_SCORE)
@@ -366,6 +367,263 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     return state.tree
 
 
+class _PState(NamedTuple):
+    tree: TreeArrays
+    hist: jax.Array             # [L, F, 2, B]
+    bests: BestSplit            # arrays [L]
+    cont: jax.Array             # scalar bool
+    cmin: jax.Array             # [L] monotone lower bounds
+    cmax: jax.Array             # [L] upper bounds
+    begin: jax.Array            # [L] i32 window start (physical, partitioned)
+    wcount: jax.Array           # [L] i32 window length (physical rows)
+    binsp: jax.Array            # [N, F] bins, leaf-partitioned
+    valsp: jax.Array            # [N, 2] (grad, hess), leaf-partitioned
+    order: jax.Array            # [N] i32: position -> original row
+
+
+def _ffill_nonzero(x: jax.Array) -> jax.Array:
+    """Forward-fill zeros with the last nonzero value (log-doubling)."""
+    n = x.shape[0]
+    shift = 1
+    while shift < n:
+        shifted = jnp.concatenate([jnp.zeros((shift,), x.dtype), x[:-shift]])
+        x = jnp.where(x > 0, x, shifted)
+        shift *= 2
+    return x
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "max_depth", "params", "num_bins",
+                     "use_pallas", "has_categorical", "has_monotone"))
+def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                           num_data: jax.Array, feature_mask: jax.Array,
+                           feat: FeatureInfo, *, num_leaves: int,
+                           max_depth: int, params: SplitParams, num_bins: int,
+                           use_pallas: bool = False,
+                           has_categorical: bool = False,
+                           has_monotone: bool = False) -> TreeArrays:
+    """Leaf-wise growth with per-leaf physical row partitions.
+
+    The TPU counterpart of the reference's ``DataPartition``
+    (data_partition.hpp:20-237): rows are kept physically grouped by leaf in a
+    working copy of the binned matrix, every split stable-partitions only the
+    parent leaf's window (a bucketed dynamic slice, so cost scales with the
+    window), and the smaller child's histogram streams only its own rows
+    (serial_tree_learner.cpp:347-356 subtraction trick for the sibling).
+    Identical split semantics to :func:`build_tree`, ~num_leaves× less
+    histogram streaming on deep trees.  Single-shard only — the parallel modes
+    use :func:`build_tree`.
+    """
+    n, f = bins.shape
+    L = num_leaves
+    B = num_bins
+    f32 = jnp.float32
+    buckets = partition_buckets(n)
+    bsizes = jnp.asarray(buckets, dtype=jnp.int32)
+
+    def best_of(h, sg, sh, cnt, cmn, cmx):
+        fb = per_feature_best_combined(
+            h, feat, feature_mask, sg, sh, cnt, params,
+            any_categorical=has_categorical,
+            cmin=cmn if has_monotone else None,
+            cmax=cmx if has_monotone else None)
+        return reduce_feature_best(fb, jnp.arange(f, dtype=jnp.int32))
+
+    vmapped_best = jax.vmap(best_of)
+
+    def make_branch(R):
+        """Partition the parent window (size <= R) and histogram the smaller
+        child; returns updated partitioned arrays + the child histogram."""
+
+        def branch(binsp, valsp, order, b, c, feat_id, thr, default_left,
+                   is_cat, bitset):
+            s0 = jnp.clip(b, 0, n - R)
+            rel_b = b - s0
+            binsw = jax.lax.dynamic_slice(binsp, (s0, 0), (R, f))
+            valsw = jax.lax.dynamic_slice(valsp, (s0, 0), (R, 2))
+            ordw = jax.lax.dynamic_slice(order, (s0,), (R,))
+            iota = jnp.arange(R, dtype=jnp.int32)
+            colw = jnp.sum(binsw.astype(jnp.int32)
+                           * (jnp.arange(f, dtype=jnp.int32) == feat_id),
+                           axis=1)
+            glw = _route_left(colw, thr, default_left,
+                              feat.missing_type[feat_id],
+                              feat.num_bin[feat_id],
+                              feat.default_bin[feat_id],
+                              is_cat=is_cat, bitset=bitset)
+            inw = (iota >= rel_b) & (iota < rel_b + c)
+            gl = glw & inw
+            nl = jnp.sum(gl, dtype=jnp.int32)
+            cl = jnp.cumsum(gl, dtype=jnp.int32)
+            cr = jnp.cumsum(inw & ~gl, dtype=jnp.int32)
+            dest = jnp.where(gl, rel_b + cl - 1,
+                             jnp.where(inw, rel_b + nl + cr - 1, iota))
+            src = jnp.zeros((R,), jnp.int32).at[dest].set(
+                iota, unique_indices=True)
+            binsw = jnp.take(binsw, src, axis=0, unique_indices=True)
+            valsw = jnp.take(valsw, src, axis=0, unique_indices=True)
+            ordw = jnp.take(ordw, src, unique_indices=True)
+            binsp = jax.lax.dynamic_update_slice(binsp, binsw, (s0, 0))
+            valsp = jax.lax.dynamic_update_slice(valsp, valsw, (s0, 0))
+            order = jax.lax.dynamic_update_slice(order, ordw, (s0,))
+            # smaller child's histogram from the fresh slice
+            left_smaller = nl * 2 <= c
+            rel_s = jnp.where(left_smaller, rel_b, rel_b + nl)
+            cnt_s = jnp.minimum(nl, c - nl)
+            hist_small = build_histogram_masked(binsw, valsw, B, rel_s, cnt_s,
+                                                use_pallas)
+            return binsp, valsp, order, hist_small, nl, left_smaller
+
+        return branch
+
+    branches = [make_branch(R) for R in buckets]
+
+    # ---- root ----
+    values = jnp.stack([grad, hess], axis=1)
+    hist0 = build_histogram_masked(bins, values, B, jnp.int32(0), jnp.int32(n),
+                                   use_pallas)
+    sum_g = jnp.sum(grad)
+    sum_h = jnp.sum(hess)
+    no_min = jnp.float32(-np.inf)
+    no_max = jnp.float32(np.inf)
+    best0 = best_of(hist0, sum_g, sum_h, num_data, no_min, no_max)
+
+    def zl(dtype=f32):
+        return jnp.zeros((L,), dtype=dtype)
+
+    tree = TreeArrays(
+        split_feature=zl(jnp.int32), threshold_bin=zl(jnp.int32),
+        split_gain=zl(), default_left=zl(bool),
+        left_child=zl(jnp.int32), right_child=zl(jnp.int32),
+        internal_value=zl(), internal_weight=zl(), internal_count=zl(),
+        leaf_value=zl(), leaf_weight=zl().at[0].set(sum_h),
+        leaf_count=zl().at[0].set(num_data.astype(f32)),
+        leaf_parent=jnp.full((L,), -1, dtype=jnp.int32), leaf_depth=zl(jnp.int32),
+        cat_bitset=jnp.zeros((L, B // 32), dtype=jnp.uint32),
+        num_leaves=jnp.int32(1), row_leaf=jnp.zeros((n,), dtype=jnp.int32))
+
+    hist = jnp.zeros((L,) + hist0.shape, dtype=f32).at[0].set(hist0)
+    bests = BestSplit(*[jnp.broadcast_to(x, (L,) + x.shape).astype(x.dtype)
+                        for x in best0])
+    state = _PState(tree=tree, hist=hist, bests=bests, cont=jnp.bool_(True),
+                    cmin=jnp.full((L,), -np.inf, dtype=f32),
+                    cmax=jnp.full((L,), np.inf, dtype=f32),
+                    begin=zl(jnp.int32),
+                    wcount=zl(jnp.int32).at[0].set(n),
+                    binsp=bins, valsp=values,
+                    order=jnp.arange(n, dtype=jnp.int32))
+
+    def body(k, st: _PState) -> _PState:
+        node = k - 1
+        t = st.tree
+        gains = jnp.where(jnp.arange(L) < t.num_leaves, st.bests.gain, K_MIN_SCORE)
+        if max_depth > 0:
+            gains = jnp.where(t.leaf_depth < max_depth, gains, K_MIN_SCORE)
+        leaf = jnp.argmax(gains).astype(jnp.int32)
+        ok = (gains[leaf] > 0.0) & st.cont
+
+        def do_split(st: _PState) -> _PState:
+            t = st.tree
+            b = BestSplit(*[x[leaf] for x in st.bests])
+            wb, wc = st.begin[leaf], st.wcount[leaf]
+            which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
+            binsp, valsp, order, hist_small, nl, left_smaller = jax.lax.switch(
+                which, branches, st.binsp, st.valsp, st.order, wb, wc,
+                b.feature, b.threshold, b.default_left,
+                feat.is_categorical[b.feature], b.cat_bitset)
+
+            hist_larger = st.hist[leaf] - hist_small
+            hist_left = jnp.where(left_smaller, hist_small, hist_larger)
+            hist_right = jnp.where(left_smaller, hist_larger, hist_small)
+            hist_new = st.hist.at[leaf].set(hist_left).at[k].set(hist_right)
+
+            begin = st.begin.at[k].set(wb + nl)
+            wcount = st.wcount.at[leaf].set(nl).at[k].set(wc - nl)
+
+            # monotone constraint propagation
+            # (monotone_constraints.hpp UpdateConstraints)
+            pmin, pmax = st.cmin[leaf], st.cmax[leaf]
+            if has_monotone and feat.monotone is not None:
+                mono_f = feat.monotone[b.feature]
+            else:
+                mono_f = jnp.int32(0)
+            is_num = ~feat.is_categorical[b.feature]
+            mid = (b.left_output + b.right_output) * 0.5
+            lmin = jnp.where(is_num & (mono_f < 0), jnp.maximum(pmin, mid), pmin)
+            lmax = jnp.where(is_num & (mono_f > 0), jnp.minimum(pmax, mid), pmax)
+            rmin = jnp.where(is_num & (mono_f > 0), jnp.maximum(pmin, mid), pmin)
+            rmax = jnp.where(is_num & (mono_f < 0), jnp.minimum(pmax, mid), pmax)
+            cmin_new = st.cmin.at[leaf].set(lmin).at[k].set(rmin)
+            cmax_new = st.cmax.at[leaf].set(lmax).at[k].set(rmax)
+
+            child_best = vmapped_best(
+                jnp.stack([hist_left, hist_right]),
+                jnp.stack([b.left_sum_grad, b.right_sum_grad]),
+                jnp.stack([b.left_sum_hess, b.right_sum_hess]),
+                jnp.stack([b.left_count, b.right_count]),
+                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]))
+            bests = _bests_update(st.bests, leaf,
+                                  BestSplit(*[x[0] for x in child_best]))
+            bests = _bests_update(bests, k, BestSplit(*[x[1] for x in child_best]))
+
+            # parent child-pointer fixup (tree.h:338-346)
+            parent = t.leaf_parent[leaf]
+            pidx = jnp.maximum(parent, 0)
+            lc = t.left_child
+            rc = t.right_child
+            lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~leaf),
+                                           node, lc[pidx]))
+            rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~leaf),
+                                           node, rc[pidx]))
+
+            tree_new = TreeArrays(
+                split_feature=t.split_feature.at[node].set(b.feature),
+                threshold_bin=t.threshold_bin.at[node].set(b.threshold),
+                split_gain=t.split_gain.at[node].set(b.gain),
+                default_left=t.default_left.at[node].set(b.default_left),
+                left_child=lc.at[node].set(~leaf),
+                right_child=rc.at[node].set(~k),
+                internal_value=t.internal_value.at[node].set(t.leaf_value[leaf]),
+                internal_weight=t.internal_weight.at[node].set(t.leaf_weight[leaf]),
+                internal_count=t.internal_count.at[node].set(
+                    b.left_count + b.right_count),
+                leaf_value=t.leaf_value.at[leaf].set(
+                    jnp.nan_to_num(b.left_output)).at[k].set(
+                    jnp.nan_to_num(b.right_output)),
+                leaf_weight=t.leaf_weight.at[leaf].set(
+                    b.left_sum_hess).at[k].set(b.right_sum_hess),
+                leaf_count=t.leaf_count.at[leaf].set(
+                    b.left_count).at[k].set(b.right_count),
+                leaf_parent=t.leaf_parent.at[leaf].set(node).at[k].set(node),
+                leaf_depth=t.leaf_depth.at[k].set(
+                    t.leaf_depth[leaf] + 1).at[leaf].add(1),
+                cat_bitset=t.cat_bitset.at[node].set(b.cat_bitset),
+                num_leaves=t.num_leaves + 1,
+                row_leaf=t.row_leaf)
+            return _PState(tree=tree_new, hist=hist_new, bests=bests,
+                           cont=st.cont, cmin=cmin_new, cmax=cmax_new,
+                           begin=begin, wcount=wcount,
+                           binsp=binsp, valsp=valsp, order=order)
+
+        return jax.lax.cond(ok, do_split,
+                            lambda s: s._replace(cont=jnp.bool_(False)), st)
+
+    if L > 1:
+        state = jax.lax.fori_loop(1, L, body, state)
+
+    # reconstruct per-row leaf assignment from the windows + permutation
+    t = state.tree
+    valid = (jnp.arange(L) < t.num_leaves) & (state.wcount > 0)
+    marks = jnp.zeros((n,), jnp.int32).at[
+        jnp.where(valid, state.begin, n)].set(
+        jnp.arange(L, dtype=jnp.int32) + 1, mode="drop")
+    leaf_of_pos = _ffill_nonzero(marks) - 1
+    row_leaf = jnp.zeros((n,), jnp.int32).at[state.order].set(
+        leaf_of_pos, unique_indices=True)
+    return t._replace(row_leaf=row_leaf)
+
+
 @functools.partial(jax.jit, static_argnames=("num_leaves",))
 def route_binned(bins: jax.Array, tree: TreeArrays, feat: FeatureInfo,
                  *, num_leaves: int) -> jax.Array:
@@ -459,14 +717,15 @@ class SerialTreeLearner:
             feature_mask = jnp.ones((self.dataset.num_features,), dtype=bool)
         grad = self.pad_rows(grad)
         hess = self.pad_rows(hess)
-        return build_tree(self.bins, grad, hess,
-                          jnp.asarray(num_data_in_bag, dtype=jnp.int32),
-                          feature_mask, self.feat,
-                          num_leaves=self.num_leaves, max_depth=self.max_depth,
-                          params=self.params, num_bins=self.num_bins,
-                          use_pallas=self.use_pallas,
-                          has_categorical=self.has_categorical,
-                          has_monotone=self.has_monotone)
+        return build_tree_partitioned(
+            self.bins, grad, hess,
+            jnp.asarray(num_data_in_bag, dtype=jnp.int32),
+            feature_mask, self.feat,
+            num_leaves=self.num_leaves, max_depth=self.max_depth,
+            params=self.params, num_bins=self.num_bins,
+            use_pallas=self.use_pallas,
+            has_categorical=self.has_categorical,
+            has_monotone=self.has_monotone)
 
     # ---- host tree construction ----
 

@@ -78,9 +78,8 @@ for n in (250_000, 1_000_000):
 # fixed-vs-variable decomposition
 a = ((results[(1_000_000, 255)] - results[(250_000, 255)]) / 254
      - (results[(1_000_000, 31)] - results[(250_000, 31)]) / 30) / 750_000
-b255 = results[(1_000_000, 255)] / 254 - a * 1_000_000 / 1  # rough
 print(f"per-split per-row cost ~{a*1e9:.2f} ns/row; "
-      f"per-split fixed @1M/255 ~{(results[(1_000_000,255)]/254)*1e3:.2f} ms")
+      f"per-split avg @1M/255 ~{(results[(1_000_000,255)]/254)*1e3:.2f} ms")
 
 # chained histogram-only loop at 1M rows
 n = 1_000_000
