@@ -590,6 +590,90 @@ class GBDT:
         self._nl_handles = [h for h in self._nl_handles if h[1] < cut]
         self.iter_ -= 1
 
+    def refit(self, leaf_preds: np.ndarray) -> None:
+        """Refit the ensemble's leaf values on the current training data.
+
+        Counterpart of ``GBDT::RefitTree`` (gbdt.cpp:299) +
+        ``SerialTreeLearner::FitByExistingTree``
+        (serial_tree_learner.cpp:199-229): keep every tree's structure, route
+        the training rows by ``leaf_preds`` [num_data, num_models], recompute
+        each leaf's output from the gradient/hessian sums at the current
+        boosting state, blend by ``refit_decay_rate``, and rebuild the train
+        scores progressively.
+        """
+        models = self.models
+        leaf_preds = np.asarray(leaf_preds, dtype=np.int32)
+        if leaf_preds.ndim != 2 or leaf_preds.shape[0] != self.num_data \
+                or leaf_preds.shape[1] != len(models):
+            raise ValueError(
+                "leaf_preds must be [num_data, num_models] = [%d, %d]"
+                % (self.num_data, len(models)))
+        K = self.num_tree_per_iteration
+        l1 = float(self.config.lambda_l1)
+        l2 = float(self.config.lambda_l2)
+        mds = float(self.config.max_delta_step)
+        decay = float(self.config.refit_decay_rate)
+        score = np.zeros((K, self.num_data), dtype=np.float64)
+        if self.train_data.metadata.init_score is not None:
+            init = np.asarray(self.train_data.metadata.init_score,
+                              dtype=np.float64)
+            score[:] = init.reshape(K, self.num_data)
+        for it in range(len(models) // K):
+            g, h = self.objective.get_gradients(
+                jnp.asarray(score[0] if K == 1 else score, dtype=jnp.float32))
+            grad = np.asarray(g, dtype=np.float64).reshape(K, self.num_data)
+            hess = np.asarray(h, dtype=np.float64).reshape(K, self.num_data)
+            for k in range(K):
+                i = it * K + k
+                tree = models[i]
+                lp = leaf_preds[:, i]
+                nl = tree.num_leaves
+                if lp.max(initial=0) >= nl:
+                    raise ValueError("leaf prediction out of range for tree %d"
+                                     % i)
+                sum_g = np.bincount(lp, weights=grad[k], minlength=nl)
+                sum_h = np.bincount(lp, weights=hess[k], minlength=nl) + K_EPSILON
+                sg = np.sign(sum_g) * np.maximum(np.abs(sum_g) - l1, 0.0)
+                out = -sg / (sum_h + l2)
+                if mds > 0.0:
+                    out = np.clip(out, -mds, mds)
+                new_vals = (decay * tree.leaf_value[:nl]
+                            + (1.0 - decay) * out * tree.shrinkage)
+                tree.leaf_value[:nl] = new_vals
+                score[k] += new_vals[lp]
+        pad = np.zeros((K, self.train_score.shape[1] - self.num_data),
+                       dtype=np.float32)
+        self.train_score = jnp.asarray(
+            np.concatenate([score.astype(np.float32), pad], axis=1))
+        self._drop_rollback_caches()
+
+    def _drop_rollback_caches(self) -> None:
+        """Invalidate per-iteration device caches after model surgery
+        (refit/merge): a later rollback must not subtract stale outputs."""
+        self._last_iter_arrays = []
+        self._window = {}
+        self._nl_handles = []
+
+    def merge_from(self, other: "GBDT") -> None:
+        """Append another booster's trees (c_api.cpp Booster::MergeFrom).
+
+        Trees are deep-copied (the reference copies serialized models), so
+        later leaf surgery on one booster cannot leak into the other."""
+        if other.num_tree_per_iteration != self.num_tree_per_iteration:
+            raise ValueError("cannot merge boosters with different "
+                             "num_tree_per_iteration")
+        import copy
+        self.models.extend(copy.deepcopy(t) for t in other.models)
+        self.iter_ += other.iter_
+        self._drop_rollback_caches()
+
+    def set_leaf_value(self, tree_idx: int, leaf_idx: int, value: float) -> None:
+        """Directly set one leaf's output (c_api.cpp LGBM_BoosterSetLeafValue)."""
+        tree = self.models[tree_idx]
+        if not 0 <= leaf_idx < tree.num_leaves:
+            raise IndexError("leaf index %d out of range" % leaf_idx)
+        tree.leaf_value[leaf_idx] = value
+
     # ---- training driver with internal early stopping (CLI path) ----
 
     def train(self, snapshot_out: Optional[str] = None) -> None:

@@ -1,0 +1,786 @@
+"""C-ABI compatibility layer: the 64 ``LGBM_*`` entry points.
+
+Counterpart of the reference ``src/c_api.cpp:465-1620`` +
+``include/LightGBM/c_api.h`` — the contract every reference binding (Python
+ctypes, R ``.Call`` glue, SWIG/Java) sits on.  Here the exports are
+implemented over the native Python engine (``lightgbm_tpu.basic``); a real
+shared library with these C symbols is produced by ``tools/build_capi.py``
+via cffi embedding, so external ctypes/JNI/R callers can load
+``lib_lightgbm_tpu.so`` exactly like the reference's ``lib_lightgbm.so``.
+
+Two layers:
+- ``_impl_*`` functions: plain-Python argument types (numpy arrays, str,
+  int handles) holding the behavior; unit-testable without a compiler.
+- ``bind(ffi)``: registers ``@ffi.def_extern`` marshaling wrappers for the
+  embedded library build (pointer <-> numpy, out-params, error codes).
+
+Error protocol (c_api.h:29-40): every export returns 0 on success, -1 on
+failure with the message retrievable via ``LGBM_GetLastError``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset, LightGBMError
+from .config import alias_transform
+from .utils.log import Log
+
+# C_API_DTYPE_* (c_api.h:17-20)
+DTYPE_FLOAT32 = 0
+DTYPE_FLOAT64 = 1
+DTYPE_INT32 = 2
+DTYPE_INT64 = 3
+
+# C_API_PREDICT_* (c_api.h:22-25)
+PREDICT_NORMAL = 0
+PREDICT_RAW_SCORE = 1
+PREDICT_LEAF_INDEX = 2
+PREDICT_CONTRIB = 3
+
+_NP_DTYPE = {DTYPE_FLOAT32: np.float32, DTYPE_FLOAT64: np.float64,
+             DTYPE_INT32: np.int32, DTYPE_INT64: np.int64}
+
+_state = threading.local()
+
+
+def _set_last_error(msg: str) -> None:
+    _state.err = str(msg)
+
+
+def get_last_error() -> str:
+    return getattr(_state, "err", "Everything is fine")
+
+
+class _CDataset:
+    """Handle payload: a basic.Dataset plus streaming-push state and the
+    field buffers LGBM_DatasetGetField hands out (kept alive here)."""
+
+    def __init__(self, ds: Dataset, num_total_row: Optional[int] = None,
+                 ncol: Optional[int] = None) -> None:
+        self.ds = ds
+        self.field_buffers: Dict[str, np.ndarray] = {}
+        # streaming construction (LGBM_DatasetPushRows*)
+        self.pending: Optional[np.ndarray] = None
+        self.pushed = 0
+        if num_total_row is not None:
+            self.pending = np.zeros((num_total_row, ncol), dtype=np.float64)
+
+    def push(self, rows: np.ndarray, start_row: int) -> None:
+        if self.pending is None:
+            raise LightGBMError("Dataset not created for streaming push")
+        self.pending[start_row:start_row + rows.shape[0]] = rows
+        self.pushed += rows.shape[0]
+        if self.pushed >= self.pending.shape[0]:
+            self.ds.data = self.pending
+            self.pending = None
+            self.ds.construct()
+
+
+class _CBooster:
+    def __init__(self, booster: Booster) -> None:
+        self.booster = booster
+        self.train_ds: Optional[_CDataset] = None
+        self.valid_ds: List[_CDataset] = []
+        # prediction buffers for LGBM_BoosterGetPredict
+        self.predict_buffer: Dict[int, np.ndarray] = {}
+
+
+_handles: Dict[int, Any] = {}
+_next_handle = [1]
+_lock = threading.Lock()
+
+
+def _new_handle(obj) -> int:
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _handles[h] = obj
+    return h
+
+
+def _get(h: int):
+    try:
+        return _handles[int(h)]
+    except KeyError:
+        raise LightGBMError("Invalid handle %r" % h)
+
+
+def _free_handle(h: int) -> None:
+    _handles.pop(int(h), None)
+
+
+def _parse_params(parameters: str) -> Dict[str, str]:
+    """'k1=v1 k2=v2' -> dict (config.cpp Str2Map: space-separated pairs)."""
+    out: Dict[str, str] = {}
+    for tok in str(parameters or "").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+# --------------------------------------------------------------------------
+# dataset impls
+# --------------------------------------------------------------------------
+
+def _impl_dataset_create_from_file(filename: str, parameters: str,
+                                   ref: Optional[int]) -> int:
+    from .io.loader import DatasetLoader
+    from .config import Config
+    params = _parse_params(parameters)
+    cfg = Config(alias_transform(dict(params)))
+    loader = DatasetLoader(cfg)
+    ref_ds = _get(ref).ds.construct().handle if ref else None
+    binned = loader.load_from_file(filename, reference=ref_ds)
+    ds = Dataset(None, params=params)
+    ds.handle = binned
+    return _new_handle(_CDataset(ds))
+
+
+def _impl_dataset_create_from_mat(mat: np.ndarray, parameters: str,
+                                  ref: Optional[int]) -> int:
+    params = _parse_params(parameters)
+    ref_ds = _get(ref).ds if ref else None
+    ds = Dataset(mat, params=params, reference=ref_ds)
+    ds.construct()
+    return _new_handle(_CDataset(ds))
+
+
+def _impl_dataset_create_sampled(ncol: int, num_total_row: int,
+                                 parameters: str) -> int:
+    # we re-bin from the full pushed matrix, so the sample itself is unused
+    params = _parse_params(parameters)
+    ds = Dataset(None, params=params)
+    return _new_handle(_CDataset(ds, num_total_row=num_total_row, ncol=ncol))
+
+
+def _impl_dataset_create_by_reference(ref: int, num_total_row: int) -> int:
+    ref_c = _get(ref)
+    ds = Dataset(None, params=dict(ref_c.ds.params), reference=ref_c.ds)
+    return _new_handle(_CDataset(ds, num_total_row=num_total_row,
+                                 ncol=ref_c.ds.num_feature()))
+
+
+def _csr_to_dense(indptr, indices, data, num_col) -> np.ndarray:
+    nrow = len(indptr) - 1
+    mat = np.zeros((nrow, int(num_col)), dtype=np.float64)
+    for i in range(nrow):
+        lo, hi = indptr[i], indptr[i + 1]
+        mat[i, indices[lo:hi]] = data[lo:hi]
+    return mat
+
+
+def _csc_to_dense(col_ptr, indices, data, num_row) -> np.ndarray:
+    ncol = len(col_ptr) - 1
+    mat = np.zeros((int(num_row), ncol), dtype=np.float64)
+    for j in range(ncol):
+        lo, hi = col_ptr[j], col_ptr[j + 1]
+        mat[indices[lo:hi], j] = data[lo:hi]
+    return mat
+
+
+def _impl_booster_create(train: int, parameters: str) -> int:
+    params = _parse_params(parameters)
+    c_train = _get(train)
+    booster = Booster(params=alias_transform(dict(params)),
+                      train_set=c_train.ds)
+    cb = _CBooster(booster)
+    cb.train_ds = c_train
+    return _new_handle(cb)
+
+
+def _eval_names(cb: _CBooster) -> List[str]:
+    return [n for m in cb.booster._booster.train_metrics for n in m.names]
+
+
+def _predict_matrix(cb: _CBooster, mat: np.ndarray, predict_type: int,
+                    num_iteration: int, parameter: str) -> np.ndarray:
+    params = alias_transform(_parse_params(parameter))
+    kwargs = {}
+    if "start_iteration" in params:
+        kwargs["start_iteration"] = int(params.pop("start_iteration"))
+    ignored = {k: v for k, v in params.items()
+               if k not in ("verbosity", "predict_raw_score",
+                            "predict_leaf_index", "predict_contrib")}
+    if ignored:
+        Log.warning("Ignoring unsupported prediction parameters: %s",
+                    ",".join(sorted(ignored)))
+    if num_iteration < 0:
+        num_iteration = None
+    if predict_type == PREDICT_LEAF_INDEX:
+        kwargs.pop("start_iteration", None)
+        out = cb.booster.predict(mat, num_iteration=num_iteration,
+                                 pred_leaf=True, **kwargs)
+    elif predict_type == PREDICT_CONTRIB:
+        kwargs.pop("start_iteration", None)
+        out = cb.booster.predict(mat, num_iteration=num_iteration,
+                                 pred_contrib=True, **kwargs)
+    elif predict_type == PREDICT_RAW_SCORE:
+        out = cb.booster.predict(mat, num_iteration=num_iteration,
+                                 raw_score=True, **kwargs)
+    else:
+        out = cb.booster.predict(mat, num_iteration=num_iteration, **kwargs)
+    return np.ascontiguousarray(np.asarray(out, dtype=np.float64))
+
+
+def _num_predict_per_row(cb: _CBooster, predict_type: int,
+                         num_iteration: int) -> int:
+    b = cb.booster._booster
+    n_iter = b.current_iteration
+    if num_iteration > 0:
+        n_iter = min(n_iter, num_iteration)
+    if predict_type == PREDICT_LEAF_INDEX:
+        return n_iter * b.num_tree_per_iteration
+    if predict_type == PREDICT_CONTRIB:
+        return (b.max_feature_idx + 2) * b.num_tree_per_iteration
+    nc = max(int(b.num_class), 1)
+    return nc if nc > 1 else 1
+
+
+def _impl_predict_for_file(cb: _CBooster, data_filename: str,
+                           data_has_header: int, predict_type: int,
+                           num_iteration: int, parameter: str,
+                           result_filename: str) -> None:
+    from .io.parser import parse_file
+    mat, _, _ = parse_file(data_filename, header=bool(data_has_header),
+                           label_idx=0)
+    out = _predict_matrix(cb, mat, predict_type, num_iteration, parameter)
+    out2d = out.reshape(mat.shape[0], -1)
+    with open(result_filename, "w") as fh:
+        for row in out2d:
+            fh.write("\t".join(repr(float(v)) for v in row) + "\n")
+
+
+# --------------------------------------------------------------------------
+# cffi binding
+# --------------------------------------------------------------------------
+
+def bind(ffi) -> None:  # noqa: C901 - one registration block
+    """Register every LGBM_* extern with marshaling over ``ffi``."""
+    keepalive: Dict[str, Any] = {}
+
+    def _str(cptr) -> str:
+        return ffi.string(cptr).decode("utf-8") if cptr else ""
+
+    def _opt_handle(h) -> Optional[int]:
+        return int(ffi.cast("intptr_t", h)) if h else None
+
+    def _nparr(ptr, n, dtype) -> np.ndarray:
+        itemsize = np.dtype(dtype).itemsize
+        return np.frombuffer(ffi.buffer(ptr, int(n) * itemsize),
+                             dtype=dtype).copy()
+
+    def _typed(ptr, n, c_dtype) -> np.ndarray:
+        return _nparr(ffi.cast("char*", ptr), n, _NP_DTYPE[int(c_dtype)])
+
+    def _write_out(ptr, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        ffi.buffer(ptr, arr.nbytes)[:] = arr.tobytes()
+
+    def _mat_from_ptr(data, data_type, nrow, ncol, is_row_major) -> np.ndarray:
+        flat = _typed(data, int(nrow) * int(ncol), data_type)
+        if is_row_major:
+            return flat.reshape(int(nrow), int(ncol)).astype(np.float64)
+        return flat.reshape(int(ncol), int(nrow)).T.astype(np.float64)
+
+    def export(name):
+        def deco(fn):
+            def wrapper(*args):
+                try:
+                    r = fn(*args)
+                    return 0 if r is None else r
+                except Exception as e:  # noqa: BLE001 - ABI boundary
+                    _set_last_error("%s: %s" % (name, e))
+                    return -1
+            ffi.def_extern(name=name)(wrapper)
+            return fn
+        return deco
+
+    # ---- error ----
+
+    @ffi.def_extern(name="LGBM_GetLastError")
+    def _get_last_error():
+        buf = ffi.new("char[]", get_last_error().encode("utf-8"))
+        keepalive["last_error"] = buf
+        return buf
+
+    # ---- dataset creation ----
+
+    @export("LGBM_DatasetCreateFromFile")
+    def _(filename, parameters, reference, out):
+        h = _impl_dataset_create_from_file(_str(filename), _str(parameters),
+                                           _opt_handle(reference))
+        out[0] = ffi.cast("void*", h)
+
+    @export("LGBM_DatasetCreateFromSampledColumn")
+    def _(sample_data, sample_indices, ncol, num_per_col, num_sample_row,
+          num_total_row, parameters, out):
+        h = _impl_dataset_create_sampled(int(ncol), int(num_total_row),
+                                         _str(parameters))
+        out[0] = ffi.cast("void*", h)
+
+    @export("LGBM_DatasetCreateByReference")
+    def _(reference, num_total_row, out):
+        h = _impl_dataset_create_by_reference(_opt_handle(reference),
+                                              int(num_total_row))
+        out[0] = ffi.cast("void*", h)
+
+    @export("LGBM_DatasetPushRows")
+    def _(dataset, data, data_type, nrow, ncol, start_row):
+        c = _get(_opt_handle(dataset))
+        rows = _mat_from_ptr(data, data_type, nrow, ncol, 1)
+        c.push(rows, int(start_row))
+
+    @export("LGBM_DatasetPushRowsByCSR")
+    def _(dataset, indptr, indptr_type, indices, data, data_type,
+          nindptr, nelem, num_col, start_row):
+        c = _get(_opt_handle(dataset))
+        ip = _typed(indptr, nindptr, indptr_type)
+        idx = _nparr(indices, nelem, np.int32)
+        vals = _typed(data, nelem, data_type)
+        c.push(_csr_to_dense(ip, idx, vals, num_col), int(start_row))
+
+    @export("LGBM_DatasetCreateFromCSR")
+    def _(indptr, indptr_type, indices, data, data_type, nindptr, nelem,
+          num_col, parameters, reference, out):
+        ip = _typed(indptr, nindptr, indptr_type)
+        idx = _nparr(indices, nelem, np.int32)
+        vals = _typed(data, nelem, data_type)
+        mat = _csr_to_dense(ip, idx, vals, num_col)
+        out[0] = ffi.cast("void*", _impl_dataset_create_from_mat(
+            mat, _str(parameters), _opt_handle(reference)))
+
+    @export("LGBM_DatasetCreateFromCSRFunc")
+    def _(get_row_funptr, num_rows, num_col, parameters, reference, out):
+        raise LightGBMError("CreateFromCSRFunc is not supported; "
+                            "use LGBM_DatasetCreateFromCSR")
+
+    @export("LGBM_DatasetCreateFromCSC")
+    def _(col_ptr, col_ptr_type, indices, data, data_type, ncol_ptr, nelem,
+          num_row, parameters, reference, out):
+        cp = _typed(col_ptr, ncol_ptr, col_ptr_type)
+        idx = _nparr(indices, nelem, np.int32)
+        vals = _typed(data, nelem, data_type)
+        mat = _csc_to_dense(cp, idx, vals, num_row)
+        out[0] = ffi.cast("void*", _impl_dataset_create_from_mat(
+            mat, _str(parameters), _opt_handle(reference)))
+
+    @export("LGBM_DatasetCreateFromMat")
+    def _(data, data_type, nrow, ncol, is_row_major, parameters, reference,
+          out):
+        mat = _mat_from_ptr(data, data_type, nrow, ncol, int(is_row_major))
+        out[0] = ffi.cast("void*", _impl_dataset_create_from_mat(
+            mat, _str(parameters), _opt_handle(reference)))
+
+    @export("LGBM_DatasetCreateFromMats")
+    def _(nmat, data, data_type, nrow, ncol, is_row_major, parameters,
+          reference, out):
+        mats = [_mat_from_ptr(data[i], data_type, nrow[i], ncol,
+                              int(is_row_major)) for i in range(int(nmat))]
+        out[0] = ffi.cast("void*", _impl_dataset_create_from_mat(
+            np.concatenate(mats, axis=0), _str(parameters),
+            _opt_handle(reference)))
+
+    @export("LGBM_DatasetGetSubset")
+    def _(handle, used_row_indices, num_used_row_indices, parameters, out):
+        c = _get(_opt_handle(handle))
+        idx = _nparr(used_row_indices, num_used_row_indices, np.int32)
+        sub = c.ds.subset(idx, params=_parse_params(_str(parameters)))
+        sub.construct()
+        out[0] = ffi.cast("void*", _new_handle(_CDataset(sub)))
+
+    @export("LGBM_DatasetSetFeatureNames")
+    def _(handle, feature_names, num_feature_names):
+        c = _get(_opt_handle(handle))
+        names = [_str(feature_names[i]) for i in range(int(num_feature_names))]
+        c.ds.construct().handle.feature_names = names
+
+    @export("LGBM_DatasetGetFeatureNames")
+    def _(handle, feature_names, num_feature_names):
+        c = _get(_opt_handle(handle))
+        names = c.ds.get_feature_name()
+        num_feature_names[0] = len(names)
+        bufs = [ffi.new("char[]", n.encode("utf-8")) for n in names]
+        keepalive["feature_names_%d" % _opt_handle(handle)] = bufs
+        for i, b in enumerate(bufs):
+            feature_names[i] = b
+
+    @export("LGBM_DatasetFree")
+    def _(handle):
+        _free_handle(_opt_handle(handle))
+
+    @export("LGBM_DatasetSaveBinary")
+    def _(handle, filename):
+        _get(_opt_handle(handle)).ds.save_binary(_str(filename))
+
+    @export("LGBM_DatasetDumpText")
+    def _(handle, filename):
+        c = _get(_opt_handle(handle))
+        binned = c.ds.construct().handle
+        with open(_str(filename), "w") as fh:
+            fh.write("\t".join(binned.feature_names) + "\n")
+            for row in np.asarray(binned.binned):
+                fh.write("\t".join(str(int(v)) for v in row) + "\n")
+
+    @export("LGBM_DatasetSetField")
+    def _(handle, field_name, field_data, num_element, dtype):
+        c = _get(_opt_handle(handle))
+        name = _str(field_name)
+        arr = _typed(field_data, num_element, dtype)
+        c.ds.set_field(name, arr)
+
+    @export("LGBM_DatasetGetField")
+    def _(handle, field_name, out_len, out_ptr, out_type):
+        c = _get(_opt_handle(handle))
+        name = _str(field_name)
+        val = c.ds.get_field(name)
+        if val is None:
+            out_len[0] = 0
+            out_ptr[0] = ffi.NULL
+            return
+        if name == "group":
+            # reference returns query BOUNDARIES (c_api.cpp Metadata)
+            val = np.concatenate([[0], np.cumsum(np.asarray(val))])
+            arr = np.ascontiguousarray(val, dtype=np.int32)
+            out_type[0] = DTYPE_INT32
+        elif name == "init_score":
+            arr = np.ascontiguousarray(val, dtype=np.float64)
+            out_type[0] = DTYPE_FLOAT64
+        else:
+            arr = np.ascontiguousarray(val, dtype=np.float32)
+            out_type[0] = DTYPE_FLOAT32
+        c.field_buffers[name] = arr
+        out_len[0] = arr.shape[0]
+        out_ptr[0] = ffi.cast("const void*",
+                              ffi.cast("uintptr_t", arr.ctypes.data))
+
+    @export("LGBM_DatasetUpdateParam")
+    def _(handle, parameters):
+        c = _get(_opt_handle(handle))
+        c.ds.params.update(_parse_params(_str(parameters)))
+
+    @export("LGBM_DatasetGetNumData")
+    def _(handle, out):
+        out[0] = _get(_opt_handle(handle)).ds.num_data()
+
+    @export("LGBM_DatasetGetNumFeature")
+    def _(handle, out):
+        out[0] = _get(_opt_handle(handle)).ds.num_feature()
+
+    @export("LGBM_DatasetAddFeaturesFrom")
+    def _(target, source):
+        raise LightGBMError("DatasetAddFeaturesFrom is not supported")
+
+    # ---- booster ----
+
+    @export("LGBM_BoosterCreate")
+    def _(train_data, parameters, out):
+        out[0] = ffi.cast("void*", _impl_booster_create(
+            _opt_handle(train_data), _str(parameters)))
+
+    @export("LGBM_BoosterCreateFromModelfile")
+    def _(filename, out_num_iterations, out):
+        booster = Booster(model_file=_str(filename))
+        out_num_iterations[0] = booster.current_iteration()
+        out[0] = ffi.cast("void*", _new_handle(_CBooster(booster)))
+
+    @export("LGBM_BoosterLoadModelFromString")
+    def _(model_str, out_num_iterations, out):
+        booster = Booster(model_str=_str(model_str))
+        out_num_iterations[0] = booster.current_iteration()
+        out[0] = ffi.cast("void*", _new_handle(_CBooster(booster)))
+
+    @export("LGBM_BoosterFree")
+    def _(handle):
+        _free_handle(_opt_handle(handle))
+
+    @export("LGBM_BoosterShuffleModels")
+    def _(handle, start_iter, end_iter):
+        raise LightGBMError("BoosterShuffleModels is not supported")
+
+    @export("LGBM_BoosterMerge")
+    def _(handle, other_handle):
+        dst = _get(_opt_handle(handle)).booster._booster
+        src = _get(_opt_handle(other_handle)).booster._booster
+        dst.merge_from(src)
+
+    @export("LGBM_BoosterAddValidData")
+    def _(handle, valid_data):
+        cb = _get(_opt_handle(handle))
+        cv = _get(_opt_handle(valid_data))
+        cb.booster.add_valid(cv.ds, "valid_%d" % (len(cb.valid_ds) + 1))
+        cb.valid_ds.append(cv)
+
+    @export("LGBM_BoosterResetTrainingData")
+    def _(handle, train_data):
+        cb = _get(_opt_handle(handle))
+        ct = _get(_opt_handle(train_data))
+        ct.ds.construct()
+        cb.booster._train_set = ct.ds
+        cb.booster._booster.reset_training_data(
+            ct.ds.handle, cb.booster._booster.objective)
+        cb.train_ds = ct
+
+    @export("LGBM_BoosterResetParameter")
+    def _(handle, parameters):
+        cb = _get(_opt_handle(handle))
+        cb.booster.reset_parameter(_parse_params(_str(parameters)))
+
+    @export("LGBM_BoosterGetNumClasses")
+    def _(handle, out_len):
+        cb = _get(_opt_handle(handle))
+        out_len[0] = max(int(cb.booster._booster.num_class), 1)
+
+    @export("LGBM_BoosterUpdateOneIter")
+    def _(handle, is_finished):
+        cb = _get(_opt_handle(handle))
+        is_finished[0] = 1 if cb.booster.update() else 0
+
+    @export("LGBM_BoosterRefit")
+    def _(handle, leaf_preds, nrow, ncol):
+        cb = _get(_opt_handle(handle))
+        leaves = _nparr(leaf_preds, int(nrow) * int(ncol),
+                        np.int32).reshape(int(nrow), int(ncol))
+        cb.booster._booster.refit(leaves)
+
+    @export("LGBM_BoosterUpdateOneIterCustom")
+    def _(handle, grad, hess, is_finished):
+        cb = _get(_opt_handle(handle))
+        b = cb.booster._booster
+        n = b.num_data * b.num_tree_per_iteration
+        g = _nparr(grad, n, np.float32)
+        h = _nparr(hess, n, np.float32)
+        is_finished[0] = 1 if b.train_one_iter(g, h) else 0
+
+    @export("LGBM_BoosterRollbackOneIter")
+    def _(handle):
+        _get(_opt_handle(handle)).booster.rollback_one_iter()
+
+    @export("LGBM_BoosterGetCurrentIteration")
+    def _(handle, out_iteration):
+        out_iteration[0] = _get(_opt_handle(handle)).booster.current_iteration()
+
+    @export("LGBM_BoosterNumModelPerIteration")
+    def _(handle, out_tree_per_iteration):
+        out_tree_per_iteration[0] = _get(
+            _opt_handle(handle)).booster.num_model_per_iteration()
+
+    @export("LGBM_BoosterNumberOfTotalModel")
+    def _(handle, out_models):
+        out_models[0] = _get(_opt_handle(handle)).booster.num_trees()
+
+    @export("LGBM_BoosterGetEvalCounts")
+    def _(handle, out_len):
+        out_len[0] = len(_eval_names(_get(_opt_handle(handle))))
+
+    @export("LGBM_BoosterGetEvalNames")
+    def _(handle, out_len, out_strs):
+        cb = _get(_opt_handle(handle))
+        names = _eval_names(cb)
+        out_len[0] = len(names)
+        bufs = [ffi.new("char[]", n.encode("utf-8")) for n in names]
+        keepalive["eval_names_%d" % _opt_handle(handle)] = bufs
+        for i, b in enumerate(bufs):
+            out_strs[i] = b
+
+    @export("LGBM_BoosterGetFeatureNames")
+    def _(handle, out_len, out_strs):
+        cb = _get(_opt_handle(handle))
+        names = cb.booster.feature_name()
+        out_len[0] = len(names)
+        bufs = [ffi.new("char[]", n.encode("utf-8")) for n in names]
+        keepalive["bfeature_names_%d" % _opt_handle(handle)] = bufs
+        for i, b in enumerate(bufs):
+            out_strs[i] = b
+
+    @export("LGBM_BoosterGetNumFeature")
+    def _(handle, out_len):
+        out_len[0] = _get(_opt_handle(handle)).booster.num_feature()
+
+    @export("LGBM_BoosterGetEval")
+    def _(handle, data_idx, out_len, out_results):
+        cb = _get(_opt_handle(handle))
+        if int(data_idx) == 0:
+            res = cb.booster.eval_train()
+        else:
+            name = cb.booster.name_valid_sets[int(data_idx) - 1]
+            res = [r for r in cb.booster.eval_valid() if r[0] == name]
+        out_len[0] = len(res)
+        for i, (_, _, val, _) in enumerate(res):
+            out_results[i] = float(val)
+
+    @export("LGBM_BoosterGetNumPredict")
+    def _(handle, data_idx, out_len):
+        cb = _get(_opt_handle(handle))
+        b = cb.booster._booster
+        if int(data_idx) == 0:
+            n = b.num_data
+        else:
+            n = cb.valid_ds[int(data_idx) - 1].ds.num_data()
+        out_len[0] = n * max(int(b.num_class), 1)
+
+    @export("LGBM_BoosterGetPredict")
+    def _(handle, data_idx, out_len, out_result):
+        cb = _get(_opt_handle(handle))
+        scores = cb.booster._flat_score(
+            "train" if int(data_idx) == 0 else int(data_idx) - 1)
+        conv = cb.booster._booster.objective.convert_output(scores)
+        arr = np.asarray(conv, dtype=np.float64).ravel()
+        out_len[0] = arr.shape[0]
+        _write_out(out_result, arr)
+
+    @export("LGBM_BoosterPredictForFile")
+    def _(handle, data_filename, data_has_header, predict_type,
+          num_iteration, parameter, result_filename):
+        _impl_predict_for_file(_get(_opt_handle(handle)), _str(data_filename),
+                               int(data_has_header), int(predict_type),
+                               int(num_iteration), _str(parameter),
+                               _str(result_filename))
+
+    @export("LGBM_BoosterCalcNumPredict")
+    def _(handle, num_row, predict_type, num_iteration, out_len):
+        cb = _get(_opt_handle(handle))
+        out_len[0] = int(num_row) * _num_predict_per_row(
+            cb, int(predict_type), int(num_iteration))
+
+    def _predict_write(cb, mat, predict_type, num_iteration, parameter,
+                       out_len, out_result):
+        out = _predict_matrix(cb, mat, int(predict_type), int(num_iteration),
+                              parameter)
+        arr = out.ravel()
+        out_len[0] = arr.shape[0]
+        _write_out(out_result, arr)
+
+    @export("LGBM_BoosterPredictForCSR")
+    def _(handle, indptr, indptr_type, indices, data, data_type, nindptr,
+          nelem, num_col, predict_type, num_iteration, parameter, out_len,
+          out_result):
+        ip = _typed(indptr, nindptr, indptr_type)
+        idx = _nparr(indices, nelem, np.int32)
+        vals = _typed(data, nelem, data_type)
+        mat = _csr_to_dense(ip, idx, vals, num_col)
+        _predict_write(_get(_opt_handle(handle)), mat, predict_type,
+                       num_iteration, _str(parameter), out_len, out_result)
+
+    @export("LGBM_BoosterPredictForCSRSingleRow")
+    def _(handle, indptr, indptr_type, indices, data, data_type, nindptr,
+          nelem, num_col, predict_type, num_iteration, parameter, out_len,
+          out_result):
+        ip = _typed(indptr, nindptr, indptr_type)
+        idx = _nparr(indices, nelem, np.int32)
+        vals = _typed(data, nelem, data_type)
+        mat = _csr_to_dense(ip, idx, vals, num_col)
+        _predict_write(_get(_opt_handle(handle)), mat, predict_type,
+                       num_iteration, _str(parameter), out_len, out_result)
+
+    @export("LGBM_BoosterPredictForCSC")
+    def _(handle, col_ptr, col_ptr_type, indices, data, data_type, ncol_ptr,
+          nelem, num_row, predict_type, num_iteration, parameter, out_len,
+          out_result):
+        cp = _typed(col_ptr, ncol_ptr, col_ptr_type)
+        idx = _nparr(indices, nelem, np.int32)
+        vals = _typed(data, nelem, data_type)
+        mat = _csc_to_dense(cp, idx, vals, num_row)
+        _predict_write(_get(_opt_handle(handle)), mat, predict_type,
+                       num_iteration, _str(parameter), out_len, out_result)
+
+    @export("LGBM_BoosterPredictForMat")
+    def _(handle, data, data_type, nrow, ncol, is_row_major, predict_type,
+          num_iteration, parameter, out_len, out_result):
+        mat = _mat_from_ptr(data, data_type, nrow, ncol, int(is_row_major))
+        _predict_write(_get(_opt_handle(handle)), mat, predict_type,
+                       num_iteration, _str(parameter), out_len, out_result)
+
+    @export("LGBM_BoosterPredictForMatSingleRow")
+    def _(handle, data, data_type, ncol, is_row_major, predict_type,
+          num_iteration, parameter, out_len, out_result):
+        mat = _mat_from_ptr(data, data_type, 1, ncol, int(is_row_major))
+        _predict_write(_get(_opt_handle(handle)), mat, predict_type,
+                       num_iteration, _str(parameter), out_len, out_result)
+
+    @export("LGBM_BoosterPredictForMats")
+    def _(handle, data, data_type, nrow, ncol, predict_type, num_iteration,
+          parameter, out_len, out_result):
+        rows = [_mat_from_ptr(data[i], data_type, 1, ncol, 1)
+                for i in range(int(nrow))]
+        mat = np.concatenate(rows, axis=0)
+        _predict_write(_get(_opt_handle(handle)), mat, predict_type,
+                       num_iteration, _str(parameter), out_len, out_result)
+
+    @export("LGBM_BoosterSaveModel")
+    def _(handle, start_iteration, num_iteration, filename):
+        cb = _get(_opt_handle(handle))
+        ni = int(num_iteration)
+        cb.booster.save_model(_str(filename),
+                              num_iteration=None if ni < 0 else ni,
+                              start_iteration=int(start_iteration))
+
+    def _model_to_buffer(text, buffer_len, out_len, out_str):
+        data = text.encode("utf-8") + b"\0"
+        out_len[0] = len(data)
+        if int(buffer_len) >= len(data):
+            ffi.buffer(out_str, len(data))[:] = data
+
+    @export("LGBM_BoosterSaveModelToString")
+    def _(handle, start_iteration, num_iteration, buffer_len, out_len,
+          out_str):
+        cb = _get(_opt_handle(handle))
+        ni = int(num_iteration)
+        text = cb.booster.model_to_string(
+            num_iteration=None if ni < 0 else ni,
+            start_iteration=int(start_iteration))
+        _model_to_buffer(text, buffer_len, out_len, out_str)
+
+    @export("LGBM_BoosterDumpModel")
+    def _(handle, start_iteration, num_iteration, buffer_len, out_len,
+          out_str):
+        cb = _get(_opt_handle(handle))
+        ni = int(num_iteration)
+        text = json.dumps(cb.booster.dump_model(
+            num_iteration=None if ni < 0 else ni,
+            start_iteration=int(start_iteration)))
+        _model_to_buffer(text, buffer_len, out_len, out_str)
+
+    @export("LGBM_BoosterGetLeafValue")
+    def _(handle, tree_idx, leaf_idx, out_val):
+        cb = _get(_opt_handle(handle))
+        out_val[0] = float(
+            cb.booster._booster.models[int(tree_idx)].leaf_value[int(leaf_idx)])
+
+    @export("LGBM_BoosterSetLeafValue")
+    def _(handle, tree_idx, leaf_idx, val):
+        cb = _get(_opt_handle(handle))
+        cb.booster._booster.set_leaf_value(int(tree_idx), int(leaf_idx),
+                                           float(val))
+
+    @export("LGBM_BoosterFeatureImportance")
+    def _(handle, num_iteration, importance_type, out_results):
+        cb = _get(_opt_handle(handle))
+        itype = "split" if int(importance_type) == 0 else "gain"
+        imp = cb.booster.feature_importance(
+            importance_type=itype,
+            iteration=None if int(num_iteration) <= 0 else int(num_iteration))
+        _write_out(out_results, np.asarray(imp, dtype=np.float64))
+
+    # ---- network shims (network.cpp -> XLA collectives; see SURVEY §2.3) ----
+
+    @export("LGBM_NetworkInit")
+    def _(machines, local_listen_port, listen_time_out, num_machines):
+        if int(num_machines) > 1:
+            Log.warning("LGBM_NetworkInit is a compatibility no-op: "
+                        "distribution uses XLA collectives over a device "
+                        "mesh (set tree_learner and run under jax.Mesh)")
+
+    @export("LGBM_NetworkFree")
+    def _():
+        return None
+
+    @export("LGBM_NetworkInitWithFunctions")
+    def _(num_machines, rank, reduce_scatter_ext_fun, allgather_ext_fun):
+        if int(num_machines) > 1:
+            Log.warning("LGBM_NetworkInitWithFunctions is a compatibility "
+                        "no-op: external collectives are owned by XLA")

@@ -130,10 +130,26 @@ class Application:
             fh.write(code)
         Log.info("Wrote converted model to %s", out)
 
-    # ---- task=refit (gbdt.cpp:299 RefitTree) ----
+    # ---- task=refit (application.cpp:216-252 + gbdt.cpp:299 RefitTree) ----
 
     def refit(self) -> None:
-        Log.fatal("refit task is not supported yet")
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("Need input_model for refit task")
+        loader = DatasetLoader(cfg)
+        train_data = loader.load_from_file(cfg.data)
+        objective = create_objective(cfg.objective, cfg)
+        booster = create_boosting(cfg.boosting, cfg, train_data, objective)
+        with open(cfg.input_model) as fh:
+            booster.load_model_from_string(fh.read())
+        booster.reset_training_data(train_data, objective)
+        if train_data.raw_data is None:
+            Log.fatal("refit needs the raw feature values")
+        leaf_preds = booster.predict_leaf_index(
+            np.asarray(train_data.raw_data), -1)
+        booster.refit(leaf_preds)
+        booster.save_model(cfg.output_model)
+        Log.info("Finished refit, saved model to %s", cfg.output_model)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

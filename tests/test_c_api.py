@@ -1,0 +1,221 @@
+"""Smoke test of the LGBM_* C ABI through a real compiled shared library,
+mirroring the reference's ctypes driver (tests/c_api_test/test_.py:1-280):
+dataset from file/mat/CSR/CSC + binary round trip, booster train/eval/save/
+load/predict through raw C symbols.
+"""
+import ctypes
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(shutil.which("gcc") is None and
+                                shutil.which("cc") is None,
+                                reason="no C compiler for cffi embedding")
+
+dtype_float32 = 0
+dtype_float64 = 1
+dtype_int32 = 2
+dtype_int64 = 3
+
+
+def c_array(ctype, values):
+    return (ctype * len(values))(*values)
+
+
+def c_str(s):
+    return ctypes.c_char_p(s.encode("utf-8"))
+
+
+@pytest.fixture(scope="module")
+def lib(tmp_path_factory):
+    out = tmp_path_factory.mktemp("capi")
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from build_capi import build
+    path = build(str(out))
+    lib = ctypes.cdll.LoadLibrary(path)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+@pytest.fixture(scope="module")
+def data_files(tmp_path_factory):
+    out = tmp_path_factory.mktemp("capi_data")
+    rng = np.random.RandomState(7)
+    paths = {}
+    for name, n in (("train", 800), ("test", 200)):
+        X = rng.normal(size=(n, 6))
+        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+             + rng.normal(scale=0.3, size=n) > 0).astype(int)
+        mat = np.column_stack([y, X])
+        path = out / ("binary.%s" % name)
+        np.savetxt(path, mat, delimiter="\t", fmt="%.6f")
+        paths[name] = str(path)
+    return paths
+
+
+def check_call(lib, ret):
+    assert ret == 0, lib.LGBM_GetLastError().decode()
+
+
+def load_from_file(lib, filename, reference):
+    handle = ctypes.c_void_p()
+    check_call(lib, lib.LGBM_DatasetCreateFromFile(
+        c_str(filename), c_str("max_bin=15"), reference,
+        ctypes.byref(handle)))
+    return handle
+
+
+def load_from_mat(lib, filename, reference):
+    raw = np.loadtxt(filename, delimiter="\t")
+    label = raw[:, 0].astype(np.float32)
+    mat = np.ascontiguousarray(raw[:, 1:], dtype=np.float64)
+    handle = ctypes.c_void_p()
+    flat = mat.reshape(mat.size)
+    check_call(lib, lib.LGBM_DatasetCreateFromMat(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)), dtype_float64,
+        ctypes.c_int32(mat.shape[0]), ctypes.c_int32(mat.shape[1]), 1,
+        c_str("max_bin=15"), reference, ctypes.byref(handle)))
+    check_call(lib, lib.LGBM_DatasetSetField(
+        handle, c_str("label"), c_array(ctypes.c_float, label), len(label), 0))
+    return handle
+
+
+def _dense_to_csr(mat):
+    indptr, indices, data = [0], [], []
+    for row in mat:
+        nz = np.nonzero(row)[0]
+        indices.extend(int(j) for j in nz)
+        data.extend(float(v) for v in row[nz])
+        indptr.append(len(indices))
+    return indptr, indices, data
+
+
+def test_dataset(lib, data_files, tmp_path):
+    train = load_from_file(lib, data_files["train"], None)
+    num_data = ctypes.c_int()
+    check_call(lib, lib.LGBM_DatasetGetNumData(train, ctypes.byref(num_data)))
+    num_feature = ctypes.c_int()
+    check_call(lib, lib.LGBM_DatasetGetNumFeature(train,
+                                                  ctypes.byref(num_feature)))
+    assert num_data.value == 800
+    assert num_feature.value == 6
+
+    test = load_from_mat(lib, data_files["test"], train)
+    check_call(lib, lib.LGBM_DatasetFree(test))
+
+    # CSR
+    raw = np.loadtxt(data_files["test"], delimiter="\t")
+    mat = raw[:, 1:]
+    indptr, indices, data = _dense_to_csr(mat)
+    handle = ctypes.c_void_p()
+    dbuf = np.asarray(data, dtype=np.float64)
+    check_call(lib, lib.LGBM_DatasetCreateFromCSR(
+        c_array(ctypes.c_int32, indptr), dtype_int32,
+        c_array(ctypes.c_int32, indices),
+        dbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)), dtype_float64,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(mat.shape[1]), c_str("max_bin=15"), train,
+        ctypes.byref(handle)))
+    nd = ctypes.c_int()
+    check_call(lib, lib.LGBM_DatasetGetNumData(handle, ctypes.byref(nd)))
+    assert nd.value == mat.shape[0]
+    check_call(lib, lib.LGBM_DatasetFree(handle))
+
+    # binary round trip
+    bin_path = str(tmp_path / "train.binary.bin")
+    check_call(lib, lib.LGBM_DatasetSaveBinary(train, c_str(bin_path)))
+    check_call(lib, lib.LGBM_DatasetFree(train))
+    train = load_from_file(lib, bin_path, None)
+    check_call(lib, lib.LGBM_DatasetGetNumData(train, ctypes.byref(num_data)))
+    assert num_data.value == 800
+    check_call(lib, lib.LGBM_DatasetFree(train))
+
+
+def test_booster(lib, data_files, tmp_path):
+    train = load_from_mat(lib, data_files["train"], None)
+    test = load_from_mat(lib, data_files["test"], train)
+    booster = ctypes.c_void_p()
+    check_call(lib, lib.LGBM_BoosterCreate(
+        train, c_str("app=binary metric=auc num_leaves=15 verbose=-1"),
+        ctypes.byref(booster)))
+    check_call(lib, lib.LGBM_BoosterAddValidData(booster, test))
+
+    is_finished = ctypes.c_int(0)
+    auc = 0.0
+    for _ in range(1, 21):
+        check_call(lib, lib.LGBM_BoosterUpdateOneIter(
+            booster, ctypes.byref(is_finished)))
+        result = np.zeros(1, dtype=np.float64)
+        out_len = ctypes.c_int(0)
+        check_call(lib, lib.LGBM_BoosterGetEval(
+            booster, 1, ctypes.byref(out_len),
+            result.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        assert out_len.value == 1
+        auc = result[0]
+    assert auc > 0.7
+
+    n_iter = ctypes.c_int()
+    check_call(lib, lib.LGBM_BoosterGetCurrentIteration(
+        booster, ctypes.byref(n_iter)))
+    assert n_iter.value == 20
+    n_classes = ctypes.c_int()
+    check_call(lib, lib.LGBM_BoosterGetNumClasses(booster,
+                                                  ctypes.byref(n_classes)))
+    assert n_classes.value == 1
+
+    model_path = str(tmp_path / "model.txt")
+    check_call(lib, lib.LGBM_BoosterSaveModel(booster, 0, -1,
+                                              c_str(model_path)))
+    check_call(lib, lib.LGBM_BoosterFree(booster))
+    check_call(lib, lib.LGBM_DatasetFree(train))
+    check_call(lib, lib.LGBM_DatasetFree(test))
+
+    booster2 = ctypes.c_void_p()
+    num_total_model = ctypes.c_int()
+    check_call(lib, lib.LGBM_BoosterCreateFromModelfile(
+        c_str(model_path), ctypes.byref(num_total_model),
+        ctypes.byref(booster2)))
+    assert num_total_model.value == 20
+
+    raw = np.loadtxt(data_files["test"], delimiter="\t")
+    mat = np.ascontiguousarray(raw[:, 1:], dtype=np.float64)
+    label = raw[:, 0]
+    preb = np.zeros(mat.shape[0], dtype=np.float64)
+    num_preb = ctypes.c_int64()
+    flat = mat.reshape(mat.size)
+    check_call(lib, lib.LGBM_BoosterPredictForMat(
+        booster2, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+        dtype_float64, ctypes.c_int32(mat.shape[0]),
+        ctypes.c_int32(mat.shape[1]), 1, 0, 25, c_str(""),
+        ctypes.byref(num_preb),
+        preb.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert num_preb.value == mat.shape[0]
+    acc = ((preb > 0.5) == (label > 0.5)).mean()
+    assert acc > 0.7
+
+    result_path = str(tmp_path / "preb.txt")
+    check_call(lib, lib.LGBM_BoosterPredictForFile(
+        booster2, c_str(data_files["test"]), 0, 0, 25, c_str(""),
+        c_str(result_path)))
+    file_preb = np.loadtxt(result_path)
+    np.testing.assert_allclose(file_preb, preb, rtol=1e-5)
+
+    # feature importance + leaf value access
+    imp = np.zeros(mat.shape[1], dtype=np.float64)
+    check_call(lib, lib.LGBM_BoosterFeatureImportance(
+        booster2, -1, 0, imp.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert imp.sum() > 0
+    leaf_val = ctypes.c_double()
+    check_call(lib, lib.LGBM_BoosterGetLeafValue(booster2, 0, 0,
+                                                 ctypes.byref(leaf_val)))
+    check_call(lib, lib.LGBM_BoosterSetLeafValue(
+        booster2, 0, 0, ctypes.c_double(leaf_val.value)))
+    check_call(lib, lib.LGBM_BoosterFree(booster2))
+
+
+def test_network_shims(lib):
+    check_call(lib, lib.LGBM_NetworkInit(c_str("127.0.0.1:1234"), 1234, 120, 1))
+    check_call(lib, lib.LGBM_NetworkFree())
