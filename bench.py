@@ -51,14 +51,20 @@ def main() -> None:
                  max_bin=max_bin)
     booster = GBDT(cfg, ds, create_objective("binary", cfg))
 
+    def force_sync():
+        # a scalar device fetch is the only reliable completion barrier on
+        # remote/tunneled runtimes where block_until_ready returns early
+        booster.train_score.block_until_ready()
+        float(jax.device_get(booster.train_score[0, 0]))
+
     for _ in range(warmup):
         booster.train_one_iter()
-    booster.train_score.block_until_ready()
+    force_sync()
 
     t0 = time.perf_counter()
     for _ in range(iters):
         booster.train_one_iter()
-    booster.train_score.block_until_ready()
+    force_sync()
     dt = time.perf_counter() - t0
 
     row_trees_per_s = n * iters / dt

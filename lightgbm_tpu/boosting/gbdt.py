@@ -730,7 +730,24 @@ class GBDT:
                     stop = True
         return stop
 
-    # ---- prediction (host path; gbdt_prediction.cpp) ----
+    # ---- prediction (core/predict.py device scan; host fallback) ----
+
+    # below this row count the host loop wins (device compile isn't amortized)
+    _DEVICE_PREDICT_MIN_ROWS = 512
+
+    def _predict_early_stop(self) -> Tuple[float, int]:
+        """(margin, freq); margin < 0 disables
+        (prediction_early_stop.cpp:26-65, config.h pred_early_stop*)."""
+        if bool(self.config.pred_early_stop) \
+                and self.num_tree_per_iteration == 1:
+            return (float(self.config.pred_early_stop_margin),
+                    int(self.config.pred_early_stop_freq))
+        return -1.0, 10
+
+    def _use_device_predict(self, models: List[Tree], n: int) -> bool:
+        from ..core.predict import has_categorical_splits
+        return (n >= self._DEVICE_PREDICT_MIN_ROWS and len(models) > 0
+                and not has_categorical_splits(models))
 
     def _raw_predict(self, X: np.ndarray, num_iteration: int = -1,
                      start_iteration: int = 0) -> np.ndarray:
@@ -740,8 +757,26 @@ class GBDT:
         total_iter = len(self.models) // K
         end_iter = total_iter if num_iteration <= 0 else min(
             total_iter, start_iteration + num_iteration)
-        for i in range(start_iteration * K, end_iter * K):
-            out[i % K] += self.models[i].predict(X)
+        sel = self.models[start_iteration * K:end_iter * K]
+        margin, freq = self._predict_early_stop()
+        if self._use_device_predict(sel, n):
+            from ..core.predict import predict_device
+            for k in range(K):
+                out[k] = predict_device(sel[k::K], X,
+                                        early_stop_margin=margin,
+                                        round_period=freq)
+            return out
+        active = np.ones(n, dtype=bool)
+        for j, tree in enumerate(sel):
+            pred = tree.predict(X[active]) if margin >= 0 else tree.predict(X)
+            if margin >= 0:
+                out[j % K, active] += pred
+                if (j + 1) % freq == 0:
+                    active &= 2.0 * np.abs(out[j % K]) < margin
+                    if not active.any():
+                        break
+            else:
+                out[j % K] += pred
         return out
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
@@ -773,6 +808,15 @@ class GBDT:
         K = self.num_tree_per_iteration
         total_iter = len(self.models) // K
         end = total_iter if num_iteration <= 0 else min(total_iter, num_iteration)
+        sel = self.models[:end * K]
+        if self._use_device_predict(sel, len(X)):
+            from ..core.predict import predict_device
+            per_class = [predict_device(sel[k::K], X, want_leaf=True)
+                         for k in range(K)]
+            out = np.zeros((len(X), len(sel)), dtype=np.int32)
+            for k in range(K):
+                out[:, k::K] = per_class[k]
+            return out
         cols = [self.models[i].predict_leaf_index(X) for i in range(end * K)]
         return np.stack(cols, axis=1) if cols else np.zeros((len(X), 0), np.int32)
 

@@ -1,0 +1,185 @@
+"""Device batch prediction: the whole ensemble as one compiled scan.
+
+Counterpart of the reference batch predictor (src/application/predictor.hpp:29-261
++ gbdt_prediction.cpp:13-90), redesigned for the MXU instead of per-row pointer
+chasing: for every tree a host-precomputed *path matrix* P[M, L] holds +1/-1 for
+(node, leaf) pairs where the leaf's root path goes left/right through the node.
+A row's leaf is then found without any traversal:
+
+    D[n, m]   = +1 if row n goes left at node m else -1     (vectorized decide)
+    hits[n,l] = D @ P          — one [N,M]x[M,L] MXU matmul per tree
+    leaf(n)   = the single l with hits[n,l] == path_len[l]
+    score(n) += indicator @ leaf_value                       (second small matmul)
+
+`lax.scan` runs this over the stacked [T, ...] tree arrays, so predicting the
+whole ensemble is a single XLA program per row-chunk; ±1 sums are integers well
+below 2^24, so f32 equality against path_len is exact.
+
+Margin-based prediction early stop (src/application/prediction_early_stop.cpp:26-65)
+rides the same scan: every `round_period` trees, rows whose margin exceeds the
+threshold stop accumulating.
+
+Categorical splits fall back to the host path (bitset membership per node is
+pointer-y; categorical models route on host until this is hot).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tree import (K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, K_ZERO_THRESHOLD,
+                   Tree)
+
+
+class EnsembleArrays(NamedTuple):
+    """Stacked per-tree arrays, padded to common [T, M] nodes / [T, L] leaves."""
+    split_feature: jax.Array   # [T, M] i32
+    threshold: jax.Array       # [T, M] f32
+    default_left: jax.Array    # [T, M] bool
+    missing_type: jax.Array    # [T, M] i32
+    path_sign: jax.Array       # [T, M, L] f32 in {-1, 0, +1}
+    path_len: jax.Array        # [T, L] f32 (#nonzero path entries; pad -1)
+    leaf_value: jax.Array      # [T, L] f32
+
+
+def _path_matrix(tree: Tree, m: int, l: int) -> Tuple[np.ndarray, np.ndarray]:
+    P = np.zeros((m, l), dtype=np.float32)
+    plen = np.full(l, -1.0, dtype=np.float32)
+    if tree.num_leaves == 1:
+        plen[0] = 0.0
+        return P, plen
+    # walk down from the root collecting (node, direction) paths
+    stack = [(0, [])]
+    while stack:
+        node, path = stack.pop()
+        for child, sign in ((tree.left_child[node], 1.0),
+                            (tree.right_child[node], -1.0)):
+            cpath = path + [(node, sign)]
+            if child < 0:
+                leaf = ~int(child)
+                for nd, s in cpath:
+                    P[nd, leaf] = s
+                plen[leaf] = float(len(cpath))
+            else:
+                stack.append((int(child), cpath))
+    return P, plen
+
+
+def has_categorical_splits(trees: List[Tree]) -> bool:
+    return any(t.num_cat > 0 for t in trees)
+
+
+def stack_ensemble(trees: List[Tree]) -> EnsembleArrays:
+    """Host: build the stacked device arrays for a list of (same-class) trees."""
+    t_cnt = len(trees)
+    m = max(max(t.num_leaves - 1, 1) for t in trees)
+    l = max(t.num_leaves for t in trees)
+    sf = np.zeros((t_cnt, m), dtype=np.int32)
+    thr = np.zeros((t_cnt, m), dtype=np.float32)
+    dl = np.zeros((t_cnt, m), dtype=bool)
+    mt = np.zeros((t_cnt, m), dtype=np.int32)
+    ps = np.zeros((t_cnt, m, l), dtype=np.float32)
+    pl = np.full((t_cnt, l), -1.0, dtype=np.float32)
+    lv = np.zeros((t_cnt, l), dtype=np.float32)
+    for i, tree in enumerate(trees):
+        ni = max(tree.num_leaves - 1, 0)
+        sf[i, :ni] = tree.split_feature[:ni]
+        # round the f64 threshold TOWARD -inf in f32: v <= thr32 is then
+        # exactly v <= thr for every f32 input v
+        t32 = tree.threshold[:ni].astype(np.float32)
+        over = t32.astype(np.float64) > tree.threshold[:ni]
+        t32[over] = np.nextafter(t32[over], -np.inf)
+        thr[i, :ni] = t32
+        dt = tree.decision_type[:ni].astype(np.int32)
+        dl[i, :ni] = (dt & K_DEFAULT_LEFT_MASK) != 0
+        mt[i, :ni] = (dt >> 2) & 3
+        ps[i], pl[i] = _path_matrix(tree, m, l)
+        lv[i, :tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+    return EnsembleArrays(
+        split_feature=jnp.asarray(sf), threshold=jnp.asarray(thr),
+        default_left=jnp.asarray(dl), missing_type=jnp.asarray(mt),
+        path_sign=jnp.asarray(ps), path_len=jnp.asarray(pl),
+        leaf_value=jnp.asarray(lv))
+
+
+@functools.partial(jax.jit, static_argnames=("early_stop_margin",
+                                             "round_period", "want_leaf"))
+def predict_ensemble(ens: EnsembleArrays, X: jax.Array,
+                     early_stop_margin: float = -1.0, round_period: int = 10,
+                     want_leaf: bool = False):
+    """Sum of leaf outputs over all stacked trees for raw rows X [N, F].
+
+    Returns [N] scores (and [N, T] leaf indices when ``want_leaf``).  With
+    ``early_stop_margin`` >= 0, rows whose |2*score| margin exceeds it stop
+    accumulating every ``round_period`` trees
+    (CreatePredictionEarlyStopInstance "binary" in prediction_early_stop.cpp).
+    """
+    n = X.shape[0]
+
+    def tree_step(carry, tree):
+        score, active, idx = carry
+        sf, thr, dl, mt, ps, plen, lv = tree
+        cols = jnp.take(X, sf, axis=1)                     # [N, M]
+        val = jnp.where(jnp.isnan(cols) & (mt != 2)[None, :], 0.0, cols)
+        missing = (((mt == 1)[None, :] & (jnp.abs(val) <= K_ZERO_THRESHOLD))
+                   | ((mt == 2)[None, :] & jnp.isnan(val)))
+        go_left = jnp.where(missing, dl[None, :], val <= thr[None, :])
+        d = jnp.where(go_left, 1.0, -1.0).astype(jnp.float32)
+        hits = jax.lax.dot_general(d, ps, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        match = (hits == plen[None, :]).astype(jnp.float32)  # [N, L]
+        contrib = match @ lv                                 # [N]
+        score = score + jnp.where(active, contrib, 0.0)
+        if early_stop_margin >= 0:
+            margin = 2.0 * jnp.abs(score)
+            check = (idx + 1) % round_period == 0
+            active = active & jnp.where(check, margin < early_stop_margin, True)
+        if want_leaf:
+            leaf = jnp.argmax(match, axis=1).astype(jnp.int32)
+            return (score, active, idx + 1), leaf
+        return (score, active, idx + 1), None
+
+    init = (jnp.zeros((n,), jnp.float32), jnp.ones((n,), bool), jnp.int32(0))
+    (score, _, _), leaves = jax.lax.scan(tree_step, init, ens)
+    if want_leaf:
+        return score, leaves.T
+    return score
+
+
+def _pad_rows_pow2(X: np.ndarray, min_rows: int = 1024) -> Tuple[np.ndarray, int]:
+    n = X.shape[0]
+    target = min_rows
+    while target < n:
+        target *= 2
+    if target > n:
+        X = np.concatenate(
+            [X, np.zeros((target - n, X.shape[1]), dtype=X.dtype)])
+    return X, n
+
+
+def predict_device(trees: List[Tree], X: np.ndarray,
+                   early_stop_margin: float = -1.0, round_period: int = 10,
+                   want_leaf: bool = False) -> np.ndarray:
+    """Device batch prediction of one class's tree sequence on raw features.
+
+    Rows are padded to a power of two (bounded recompiles); output is [N]
+    float64 raw scores (or [N, T] int32 leaf indices with ``want_leaf``).
+    """
+    if not trees:
+        if want_leaf:
+            return np.zeros((len(X), 0), dtype=np.int32)
+        return np.zeros(len(X), dtype=np.float64)
+    ens = stack_ensemble(trees)
+    Xp, n = _pad_rows_pow2(np.asarray(X, dtype=np.float32))
+    out = predict_ensemble(ens, jnp.asarray(Xp),
+                           early_stop_margin=float(early_stop_margin),
+                           round_period=int(round_period),
+                           want_leaf=want_leaf)
+    if want_leaf:
+        score, leaves = out
+        return np.asarray(leaves[:n]).astype(np.int32)
+    return np.asarray(out[:n], dtype=np.float64)

@@ -1,0 +1,90 @@
+"""Device ensemble prediction (core/predict.py): parity with the host
+per-tree traversal, leaf-index parity, and margin-based prediction early stop
+(prediction_early_stop.cpp:26-65 semantics)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.predict import predict_device, stack_ensemble
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+
+
+@pytest.fixture(scope="module")
+def booster():
+    rng = np.random.RandomState(5)
+    n = 4000
+    X = rng.normal(size=(n, 7)).astype(np.float32)
+    X[rng.uniform(size=X.shape) < 0.05] = np.nan  # exercise missing routing
+    y = (np.nan_to_num(X[:, 0]) + 0.4 * np.nan_to_num(X[:, 1])
+         + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    cfg = Config(objective="binary", num_leaves=15, num_iterations=25,
+                 learning_rate=0.2, max_bin=63)
+    b = GBDT(cfg, ds, create_objective("binary", cfg))
+    for _ in range(25):
+        b.train_one_iter()
+    return b, X
+
+
+def test_device_matches_host(booster):
+    b, X = booster
+    Xq = X[:1500]
+    host = np.zeros(len(Xq))
+    for t in b.models:
+        host += t.predict(Xq)
+    dev = predict_device(b.models, Xq)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_booster_predict_uses_device(booster):
+    b, X = booster
+    # large input -> device path; small input -> host loop; must agree
+    big = b.predict(X, raw_score=True)
+    small = np.concatenate([b.predict(X[i:i + 100], raw_score=True)
+                            for i in range(0, len(X), 100)])
+    np.testing.assert_allclose(big, small, rtol=1e-5, atol=1e-6)
+
+
+def test_leaf_index_parity(booster):
+    b, X = booster
+    Xq = X[:1024]
+    dev = b.predict_leaf_index(Xq)
+    host = np.stack([t.predict_leaf_index(Xq) for t in b.models], axis=1)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_prediction_early_stop(booster):
+    b, X = booster
+    full = b.predict(X, raw_score=True)
+    b.config.set({"pred_early_stop": "true", "pred_early_stop_freq": "5",
+                  "pred_early_stop_margin": "0.5"})
+    try:
+        stopped = b.predict(X, raw_score=True)
+        # small-margin rows keep accumulating and stay identical
+        margin_small = np.abs(2.0 * full) < 0.5
+        changed = stopped != full
+        assert changed.any(), "early stop should truncate some rows"
+        # every changed row must already have a confident (large) margin
+        assert (np.abs(2.0 * stopped[changed]) >= 0.5).all()
+        # decisions overwhelmingly agree (a frozen row may flip later in the
+        # full run when the margin threshold is small; reference default is 10)
+        agree = ((stopped > 0) == (full > 0))[changed].mean()
+        assert agree > 0.9
+        # host path agrees with device path under early stop
+        host = np.concatenate([b.predict(X[i:i + 100], raw_score=True)
+                               for i in range(0, len(X), 100)])
+        np.testing.assert_allclose(stopped, host, rtol=1e-5, atol=1e-6)
+        del margin_small
+    finally:
+        b.config.set({"pred_early_stop": "false"})
+
+
+def test_stack_ensemble_shapes(booster):
+    b, _ = booster
+    ens = stack_ensemble(b.models)
+    t = len(b.models)
+    assert ens.split_feature.shape[0] == t
+    assert ens.path_sign.shape[0] == t
+    assert (np.asarray(ens.path_len).max(axis=1) > 0).all()
