@@ -47,7 +47,7 @@ def main() -> None:
 
     ds = BinnedDataset.from_matrix(X, label=y, max_bin=max_bin)
     cfg = Config(objective="binary", num_leaves=leaves,
-                 num_iterations=iters + warmup, learning_rate=0.1,
+                 num_iterations=2 * iters + warmup, learning_rate=0.1,
                  max_bin=max_bin)
     booster = GBDT(cfg, ds, create_objective("binary", cfg))
 
@@ -57,13 +57,13 @@ def main() -> None:
         booster.train_score.block_until_ready()
         float(jax.device_get(booster.train_score[0, 0]))
 
-    for _ in range(warmup):
-        booster.train_one_iter()
+    # warmup compiles both the k=warmup and the k=iters fused programs
+    booster.train_chunk(warmup)
+    booster.train_chunk(iters)
     force_sync()
 
     t0 = time.perf_counter()
-    for _ in range(iters):
-        booster.train_one_iter()
+    booster.train_chunk(iters)
     force_sync()
     dt = time.perf_counter() - t0
 

@@ -156,6 +156,7 @@ class Dataset:
             use_missing=bool(cfg.use_missing),
             zero_as_missing=bool(cfg.zero_as_missing),
             data_random_seed=int(cfg.data_random_seed),
+            enable_bundle=bool(cfg.enable_bundle),
             feature_names=names, reference=ref_handle,
             max_bin_by_feature=(list(cfg.max_bin_by_feature)
                                 if cfg.max_bin_by_feature else None),

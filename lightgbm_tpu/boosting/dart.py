@@ -9,6 +9,7 @@ from ..utils.log import Log
 
 
 class DART(GBDT):
+    fuse_iters = False
     lazy_trees = False  # dropout shrinks/re-adds host trees every iteration
 
     def __init__(self, config, train_data=None, objective=None, mesh=None):

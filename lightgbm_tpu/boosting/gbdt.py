@@ -27,7 +27,8 @@ import numpy as np
 
 from ..config import Config
 from ..core.tree import Tree
-from ..core.tree_learner import (SerialTreeLearner, TreeArrays, route_binned,
+from ..core.tree_learner import (SerialTreeLearner, TreeArrays,
+                                 build_tree_partitioned, route_binned,
                                  tree_from_arrays)
 from ..parallel import create_tree_learner
 from ..io.dataset import BinnedDataset
@@ -38,6 +39,25 @@ from ..utils.timer import FunctionTimer
 
 K_EPSILON = 1e-15
 MODEL_VERSION = "v3"
+
+
+class _LazyTreeSlice:
+    """One tree of a fused-chunk's stacked TreeArrays, sliced on demand so the
+    hot path never issues per-tree device ops (each dispatch is a host
+    round-trip on tunneled runtimes)."""
+
+    __slots__ = ("stacked", "i")
+
+    def __init__(self, stacked: TreeArrays, i: int) -> None:
+        self.stacked = stacked
+        self.i = i
+
+    def resolve(self) -> TreeArrays:
+        return jax.tree_util.tree_map(lambda a: a[self.i], self.stacked)
+
+
+def _resolve_arrays(arrays) -> TreeArrays:
+    return arrays.resolve() if isinstance(arrays, _LazyTreeSlice) else arrays
 
 
 class GBDT:
@@ -99,14 +119,36 @@ class GBDT:
         self._window: Dict[int, TreeArrays] = {}
         self._nl_handles: List[Tuple[int, int, jax.Array]] = []
         self._last_poll = 0
+        self._fused_cache: Dict = {}
 
     def _materialize_pending(self) -> None:
         idxs = sorted(self._pending)
         recs = [self._pending[i] for i in idxs]
         self._pending = {}
-        host = jax.device_get([r[0] for r in recs])  # ONE device round-trip
-        for i, rec, arr in zip(idxs, recs, host):
-            self._window[i] = rec[0]
+        # ONE device round-trip; row_leaf ([N] per tree) is not needed on
+        # host.  Fused-chunk slices share their stacked arrays: fetch each
+        # stacked chunk once and slice on host.
+        chunks: Dict[int, TreeArrays] = {}
+        singles = []
+        for rec in recs:
+            a = rec[0]
+            if isinstance(a, _LazyTreeSlice):
+                chunks.setdefault(id(a.stacked), a.stacked)
+            else:
+                singles.append(a._replace(row_leaf=a.num_leaves))
+        fetch = ([c._replace(row_leaf=c.num_leaves) for c in chunks.values()]
+                 + singles)
+        host = jax.device_get(fetch)
+        host_chunks = dict(zip(chunks.keys(), host[:len(chunks)]))
+        host_singles = iter(host[len(chunks):])
+        for i, rec in zip(idxs, recs):
+            a = rec[0]
+            if isinstance(a, _LazyTreeSlice):
+                arr = jax.tree_util.tree_map(lambda x: x[a.i],
+                                             host_chunks[id(a.stacked)])
+            else:
+                arr = next(host_singles)
+            self._window[i] = a
             tree = tree_from_arrays(arr, self.train_data, 1.0)
             if abs(rec[1]) > K_EPSILON:
                 tree.add_bias(rec[1])
@@ -131,9 +173,18 @@ class GBDT:
         nls = jax.device_get([h for _, _, h in self._nl_handles])
         by_iter: Dict[int, List[int]] = {}
         first_idx: Dict[int, int] = {}
+        K = self.num_tree_per_iteration
         for (it, idx, _), nl in zip(self._nl_handles, nls):
-            by_iter.setdefault(it, []).append(int(nl))
-            first_idx[it] = min(first_idx.get(it, idx), idx)
+            arr = np.asarray(nl)
+            if arr.ndim == 0:   # per-iteration entry: one class's tree
+                by_iter.setdefault(it, []).append(int(arr))
+                first_idx[it] = min(first_idx.get(it, idx), idx)
+            else:               # fused chunk entry: [k, K] leaves counts
+                for i in range(arr.shape[0]):
+                    by_iter.setdefault(it + i, []).extend(
+                        int(v) for v in arr[i])
+                    first_idx[it + i] = min(first_idx.get(it + i, 1 << 60),
+                                            idx + i * K)
         stalled = sorted(it for it, v in by_iter.items() if max(v) <= 1)
         if not stalled:
             self._nl_handles = []
@@ -147,7 +198,7 @@ class GBDT:
         for idx in sorted(i for i in self._pending if i >= cut):
             self._pending.pop(idx)
         for idx in sorted(trimmed):
-            arrays = trimmed[idx]
+            arrays = _resolve_arrays(trimmed[idx])
             k = idx % self.num_tree_per_iteration
             self.train_score = self.train_score.at[k].add(
                 -self._gather_tree_output(arrays))
@@ -226,7 +277,7 @@ class GBDT:
                                              valid_data.num_data))
         self.valid_sets.append({
             "name": name, "data": valid_data,
-            "bins": jnp.asarray(valid_data.binned),
+            "bins": jnp.asarray(self.learner.valid_bins(valid_data)),
             "metrics": list(metrics), "score": score,
         })
         # replay existing model onto the new validation set
@@ -456,6 +507,128 @@ class GBDT:
             return self._poll_stop()
         return False
 
+    # ---- fused multi-iteration training ----
+    #
+    # On a remote/tunneled accelerator every jitted dispatch costs a host
+    # round-trip (~100ms on axon); per-iteration training makes ~10 of them.
+    # When the iteration has no host-side decisions (no bagging, no feature
+    # sampling, no leaf renewal, device-traceable objective, serial learner,
+    # no validation sets) the whole k-iteration boosting loop runs as ONE
+    # compiled lax.scan: gradients -> tree build -> score update per step,
+    # trees emitted as stacked TreeArrays.
+
+    fuse_iters = True  # subclasses with per-iteration host logic opt out
+
+    def _can_fuse_iters(self) -> bool:
+        if not (self.fuse_iters and self.lazy_trees
+                and self.objective is not None
+                and not self.objective.is_renew_tree_output
+                and self.objective.deterministic_gradients):
+            return False
+        if self.valid_sets or not self.train_data.num_features:
+            return False
+        if not all(self.class_need_train):
+            return False
+        cfg = self.config
+        if float(cfg.bagging_fraction) < 1.0 or float(cfg.feature_fraction) < 1.0:
+            return False
+        if getattr(self.learner, "comm", None) is not None:
+            return False  # parallel learners keep the per-iteration path
+        if self._fuse_failed:
+            return False
+        return True
+
+    _fuse_failed = False
+
+    def _make_fused_train(self, k: int):
+        objective = self.objective
+        learner = self.learner
+        K = self.num_tree_per_iteration
+        rate = float(self.shrinkage_rate)
+        n = self.num_data
+        pad = learner.padded_rows
+        feat = learner.feat
+        fm = jnp.ones((self.train_data.num_features,), bool)
+        nd = jnp.int32(n)
+        kwargs = dict(num_leaves=learner.num_leaves,
+                      max_depth=learner.max_depth, params=learner.params,
+                      num_bins=learner.num_bins, use_pallas=learner.use_pallas,
+                      has_categorical=learner.has_categorical,
+                      has_monotone=learner.has_monotone,
+                      feat_num_bins=learner.feat_bins,
+                      unpack_lanes=learner.unpack_lanes)
+
+        def one_iter(score, _):
+            live = score[:, :n]
+            g, h = objective.get_gradients(live[0] if K == 1 else live)
+            g = jnp.reshape(g, (K, n))
+            h = jnp.reshape(h, (K, n))
+            outs = []
+            for kk in range(K):
+                gk = jnp.pad(g[kk], (0, pad))
+                hk = jnp.pad(h[kk], (0, pad))
+                arr = build_tree_partitioned(learner.bins, gk, hk, nd, fm,
+                                             feat, **kwargs)
+                arr = arr._replace(
+                    leaf_value=arr.leaf_value * rate,
+                    internal_value=arr.internal_value * rate)
+                score = score.at[kk].add(arr.leaf_value[arr.row_leaf])
+                outs.append(arr)
+            return score, tuple(outs)
+
+        def fused(score):
+            return jax.lax.scan(one_iter, score, None, length=k)
+
+        return jax.jit(fused)
+
+    def train_chunk(self, num_iters: int) -> bool:
+        """Run up to ``num_iters`` boosting iterations; fused into one XLA
+        program when the configuration allows, else per-iteration.  Returns
+        True when training stopped (no more splittable leaves)."""
+        if num_iters <= 0:
+            return False
+        if not self._can_fuse_iters():
+            for _ in range(num_iters):
+                if self.train_one_iter():
+                    return True
+            return False
+        # probe traceability BEFORE any state mutation so the fallback path
+        # does not re-apply boost_from_average
+        key = (num_iters, self.shrinkage_rate, self.num_tree_per_iteration)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            fn = self._make_fused_train(num_iters)
+            try:
+                jax.eval_shape(fn, self.train_score)
+            except Exception as exc:  # noqa: BLE001 - objective not traceable
+                Log.debug("Fused training unavailable (%s); falling back", exc)
+                self._fuse_failed = True
+                return self.train_chunk(num_iters)
+            self._fused_cache[key] = fn
+        init_scores = [self._boost_from_average(kk, True)
+                       for kk in range(self.num_tree_per_iteration)]
+        new_score, stacked = fn(self.train_score)
+        self.train_score = new_score
+        K = self.num_tree_per_iteration
+        first_idx = len(self._models)
+        first_iter = self.iter_
+        self._last_iter_arrays = []
+        for i in range(num_iters):
+            for kk in range(K):
+                idx = len(self._models)
+                self._models.append(None)
+                self._pending[idx] = (_LazyTreeSlice(stacked[kk], i),
+                                      init_scores[kk] if i == 0 else 0.0)
+        self._nl_handles.append(
+            (first_iter, first_idx,
+             jnp.stack([s.num_leaves for s in stacked], axis=1)))
+        self._last_iter_arrays = [_LazyTreeSlice(stacked[kk], num_iters - 1)
+                                  for kk in range(K)]
+        self.iter_ += num_iters
+        if self.iter_ - self._last_poll >= self._poll_freq:
+            return self._poll_stop()
+        return False
+
     def _train_one_iter_sync(self, gradients: Optional[np.ndarray] = None,
                              hessians: Optional[np.ndarray] = None) -> bool:
         """Synchronous path (host Tree per iteration): DART and leaf-renewal
@@ -577,6 +750,7 @@ class GBDT:
             arrays = (self._last_iter_arrays[k]
                       if k < len(self._last_iter_arrays) else None)
             if arrays is not None:
+                arrays = _resolve_arrays(arrays)
                 self.train_score = self.train_score.at[k].add(
                     -self._gather_tree_output(arrays))
             for vs in self.valid_sets:
@@ -677,16 +851,28 @@ class GBDT:
     # ---- training driver with internal early stopping (CLI path) ----
 
     def train(self, snapshot_out: Optional[str] = None) -> None:
-        for it in range(self.iter_, int(self.config.num_iterations)):
-            finished = self.train_one_iter()
-            if not finished and self.config.metric_freq > 0 \
-                    and it % self.config.metric_freq == 0:
+        total = int(self.config.num_iterations)
+        has_eval = bool(self.train_metrics) or bool(self.valid_sets)
+        mf = int(self.config.metric_freq)
+        sf = int(self.config.snapshot_freq)
+        # fused chunks run to the next eval/snapshot boundary in one program
+        npad = self.num_data + getattr(self.learner, "padded_rows", 0)
+        chunk_cap = int(max(1, min(64, (1 << 31) // max(4 * npad, 1))))
+        while self.iter_ < total:
+            it = self.iter_
+            nxt = total
+            if has_eval and mf > 0:
+                nxt = min(nxt, it + mf - (it % mf))
+            if snapshot_out and sf > 0:
+                nxt = min(nxt, it + sf - (it % sf))
+            finished = self.train_chunk(min(nxt - it, chunk_cap))
+            if not finished and has_eval and mf > 0 \
+                    and self.iter_ % mf == 0:
                 finished = self.eval_and_check_early_stopping()
             if finished:
                 break
-            if (snapshot_out and self.config.snapshot_freq > 0
-                    and (it + 1) % self.config.snapshot_freq == 0):
-                path = "%s.snapshot_iter_%d" % (snapshot_out, it + 1)
+            if (snapshot_out and sf > 0 and self.iter_ % sf == 0):
+                path = "%s.snapshot_iter_%d" % (snapshot_out, self.iter_)
                 self.save_model(path)
         if self._nl_handles:
             self._poll_stop()  # trim any trailing stalled iterations
@@ -738,8 +924,12 @@ class GBDT:
     def _predict_early_stop(self) -> Tuple[float, int]:
         """(margin, freq); margin < 0 disables
         (prediction_early_stop.cpp:26-65, config.h pred_early_stop*)."""
+        # gated on !NeedAccuratePrediction like the reference predictor
+        # (predictor.hpp:38-47)
         if bool(self.config.pred_early_stop) \
-                and self.num_tree_per_iteration == 1:
+                and self.num_tree_per_iteration == 1 \
+                and self.objective is not None \
+                and not self.objective.need_accurate_prediction:
             return (float(self.config.pred_early_stop_margin),
                     int(self.config.pred_early_stop_freq))
         return -1.0, 10

@@ -423,7 +423,7 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
         binned = c.ds.construct().handle
         with open(_str(filename), "w") as fh:
             fh.write("\t".join(binned.feature_names) + "\n")
-            for row in np.asarray(binned.binned):
+            for row in np.asarray(binned.unbundled_matrix()):
                 fh.write("\t".join(str(int(v)) for v in row) + "\n")
 
     @export("LGBM_DatasetSetField")

@@ -62,6 +62,10 @@ class FeatureInfo(NamedTuple):
     default_bin: jax.Array   # i32
     is_categorical: jax.Array  # bool
     monotone: jax.Array = None  # i32 in {-1, 0, +1}; None == unconstrained
+    # EFB bundling (dataset.cpp:92-290): the binned matrix column of each
+    # feature and its first group code; None == one column per feature
+    group: jax.Array = None  # i32 [F] -> group column
+    offset: jax.Array = None  # i32 [F] first group code of bin 1
 
 
 class BestSplit(NamedTuple):

@@ -102,6 +102,22 @@ def _bests_update(bests: BestSplit, idx, new: BestSplit) -> BestSplit:
     return BestSplit(*[f.at[idx].set(n) for f, n in zip(bests, new)])
 
 
+def _unfold_bin(col, f_id, feat: FeatureInfo):
+    """EFB group code -> feature bin: codes [off, off+nb-2] hold bins
+    1..nb-1, anything else means the feature sits at bin 0 (its default).
+    Singleton groups use offset 1, making this the identity."""
+    if feat.offset is None:
+        return col
+    off = feat.offset[f_id]
+    nb = feat.num_bin[f_id]
+    return jnp.where((col >= off) & (col <= off + nb - 2), col - off + 1, 0)
+
+
+def _feature_column(f_id, feat: FeatureInfo):
+    """The binned-matrix column holding feature f (its group's column)."""
+    return f_id if feat.group is None else feat.group[f_id]
+
+
 def _route_left(col, threshold, default_left, mt, nb, dbin,
                 is_cat=None, bitset=None):
     """Decision on binned values: NumericalDecisionInner (tree.h:262-277) or,
@@ -156,7 +172,8 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         def _slc(a):
             return jax.lax.dynamic_slice_in_dim(a, off, chunk, axis=0)
-        feat_c = FeatureInfo(*[_slc(a) for a in feat])
+        feat_c = FeatureInfo(*[None if a is None else _slc(a)
+                              for a in feat])
         mask_c = _slc(feature_mask)
         ids_c = off + jnp.arange(chunk, dtype=jnp.int32)
 
@@ -223,7 +240,8 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         key = votes - jnp.arange(f, dtype=f32) / (f + 1.0)  # ties → smaller id
         elected = jnp.sort(jax.lax.top_k(key, min(2 * k, f))[1]).astype(jnp.int32)
         he = jax.lax.psum(h[elected], ax)
-        feat_e = FeatureInfo(*[a[elected] for a in feat])
+        feat_e = FeatureInfo(*[None if a is None else a[elected]
+                              for a in feat])
         fb = pfb(he, feat_e, feature_mask[elected], sg, sh, cnt, params,
                  cmn, cmx)
         return reduce_feature_best(fb, elected)
@@ -276,8 +294,10 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             t = st.tree
             b = BestSplit(*[x[leaf] for x in st.bests])
             feat_id, thr = b.feature, b.threshold
-            col = jax.lax.dynamic_index_in_dim(bins, feat_id, axis=1,
-                                               keepdims=False).astype(jnp.int32)
+            col = jax.lax.dynamic_index_in_dim(
+                bins, _feature_column(feat_id, feat), axis=1,
+                keepdims=False).astype(jnp.int32)
+            col = _unfold_bin(col, feat_id, feat)
             go_left = _route_left(col, thr, b.default_left,
                                   feat.missing_type[feat_id],
                                   feat.num_bin[feat_id],
@@ -395,14 +415,17 @@ def _ffill_nonzero(x: jax.Array) -> jax.Array:
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "params", "num_bins",
-                     "use_pallas", "has_categorical", "has_monotone"))
+                     "use_pallas", "has_categorical", "has_monotone",
+                     "feat_num_bins"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
                            max_depth: int, params: SplitParams, num_bins: int,
                            use_pallas: bool = False,
                            has_categorical: bool = False,
-                           has_monotone: bool = False) -> TreeArrays:
+                           has_monotone: bool = False,
+                           feat_num_bins: int = 0,
+                           unpack_lanes=None) -> TreeArrays:
     """Leaf-wise growth with per-leaf physical row partitions.
 
     The TPU counterpart of the reference's ``DataPartition``
@@ -415,16 +438,30 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     histogram streaming on deep trees.  Single-shard only — the parallel modes
     use :func:`build_tree`.
     """
-    n, f = bins.shape
+    n, ncols = bins.shape
+    f = feat.num_bin.shape[0]          # features may outnumber group columns
     L = num_leaves
-    B = num_bins
+    B = feat_num_bins or num_bins      # per-feature scan width
     f32 = jnp.float32
     buckets = partition_buckets(n)
     bsizes = jnp.asarray(buckets, dtype=jnp.int32)
 
+    def unpack(h, sg, sh):
+        """Group-column histogram [G, 2, Bg] -> per-feature [F, 2, B] with the
+        shared default bin recovered by subtraction from the leaf totals
+        (dataset.h:501 FixHistogram)."""
+        if unpack_lanes is None:
+            return h
+        lidx, lmask = unpack_lanes
+        hf = jnp.take_along_axis(h[feat.group], lidx[:, None, :], axis=2)
+        hf = hf * lmask[:, None, :]
+        rest = jnp.sum(hf, axis=2)
+        return hf.at[:, 0, 0].set(sg - rest[:, 0]).at[:, 1, 0].set(
+            sh - rest[:, 1])
+
     def best_of(h, sg, sh, cnt, cmn, cmx):
         fb = per_feature_best_combined(
-            h, feat, feature_mask, sg, sh, cnt, params,
+            unpack(h, sg, sh), feat, feature_mask, sg, sh, cnt, params,
             any_categorical=has_categorical,
             cmin=cmn if has_monotone else None,
             cmax=cmx if has_monotone else None)
@@ -440,13 +477,15 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    is_cat, bitset):
             s0 = jnp.clip(b, 0, n - R)
             rel_b = b - s0
-            binsw = jax.lax.dynamic_slice(binsp, (s0, 0), (R, f))
+            binsw = jax.lax.dynamic_slice(binsp, (s0, 0), (R, ncols))
             valsw = jax.lax.dynamic_slice(valsp, (s0, 0), (R, 2))
             ordw = jax.lax.dynamic_slice(order, (s0,), (R,))
             iota = jnp.arange(R, dtype=jnp.int32)
             colw = jnp.sum(binsw.astype(jnp.int32)
-                           * (jnp.arange(f, dtype=jnp.int32) == feat_id),
+                           * (jnp.arange(ncols, dtype=jnp.int32)
+                              == _feature_column(feat_id, feat)),
                            axis=1)
+            colw = _unfold_bin(colw, feat_id, feat)
             glw = _route_left(colw, thr, default_left,
                               feat.missing_type[feat_id],
                               feat.num_bin[feat_id],
@@ -471,7 +510,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             left_smaller = nl * 2 <= c
             rel_s = jnp.where(left_smaller, rel_b, rel_b + nl)
             cnt_s = jnp.minimum(nl, c - nl)
-            hist_small = build_histogram_masked(binsw, valsw, B, rel_s, cnt_s,
+            hist_small = build_histogram_masked(binsw, valsw, num_bins, rel_s, cnt_s,
                                                 use_pallas)
             return binsp, valsp, order, hist_small, nl, left_smaller
 
@@ -481,7 +520,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- root ----
     values = jnp.stack([grad, hess], axis=1)
-    hist0 = build_histogram_masked(bins, values, B, jnp.int32(0), jnp.int32(n),
+    hist0 = build_histogram_masked(bins, values, num_bins, jnp.int32(0), jnp.int32(n),
                                    use_pallas)
     sum_g = jnp.sum(grad)
     sum_h = jnp.sum(hess)
@@ -635,8 +674,10 @@ def route_binned(bins: jax.Array, tree: TreeArrays, feat: FeatureInfo,
         is_leaf = node < 0
         nd = jnp.maximum(node, 0)
         f_id = tree.split_feature[nd]
-        col = jnp.take_along_axis(bins, f_id[:, None].astype(jnp.int32),
-                                  axis=1)[:, 0].astype(jnp.int32)
+        col = jnp.take_along_axis(
+            bins, _feature_column(f_id, feat)[:, None].astype(jnp.int32),
+            axis=1)[:, 0].astype(jnp.int32)
+        col = _unfold_bin(col, f_id, feat)
         go_left = _route_left(col, tree.threshold_bin[nd], tree.default_left[nd],
                               feat.missing_type[f_id], feat.num_bin[f_id],
                               feat.default_bin[f_id],
@@ -651,6 +692,10 @@ def route_binned(bins: jax.Array, tree: TreeArrays, feat: FeatureInfo,
 
 class SerialTreeLearner:
     """Host wrapper: owns device views + static metadata, compiles the build."""
+
+    # parallel learners shard over features and take one column per feature;
+    # the serial learner consumes EFB group columns directly
+    supports_groups = True
 
     def __init__(self, dataset: BinnedDataset, config) -> None:
         self.dataset = dataset
@@ -677,19 +722,35 @@ class SerialTreeLearner:
                 mono[j] = int(mono_cfg[orig])
         self.monotone = mono
         self.has_monotone = bool((mono != 0).any())
-        self.num_bins = _pad_bins(dataset.max_num_bin)
         self.use_pallas = jax.default_backend() == "tpu"
-        nf = dataset.num_features
+        self.grouped = bool(dataset.is_bundled and self.supports_groups)
+        self.feat_bins = _pad_bins(dataset.max_num_bin)
+        if self.grouped:
+            self.num_bins = _pad_bins(dataset.max_group_bin)
+            group = jnp.asarray(dataset.group_idx)
+            offset = jnp.asarray(dataset.bin_offset)
+            nb = np.asarray(dataset.num_bin_per_feature)
+            lanes = np.arange(self.feat_bins, dtype=np.int32)[None, :]
+            lidx = np.clip(np.asarray(dataset.bin_offset)[:, None] + lanes - 1,
+                           0, self.num_bins - 1).astype(np.int32)
+            lmask = ((lanes >= 1) & (lanes < nb[:, None])).astype(np.float32)
+            self.unpack_lanes = (jnp.asarray(lidx), jnp.asarray(lmask))
+        else:
+            self.num_bins = self.feat_bins
+            group = offset = None
+            self.unpack_lanes = None
         self.feat = FeatureInfo(
             num_bin=jnp.asarray(dataset.num_bin_per_feature, dtype=jnp.int32),
             missing_type=jnp.asarray(dataset.missing_types()),
             default_bin=jnp.asarray(dataset.default_bins()),
             is_categorical=jnp.asarray(dataset.feature_is_categorical()),
-            monotone=jnp.asarray(self.monotone))
+            monotone=jnp.asarray(self.monotone),
+            group=group, offset=offset)
         # rows padded so the Pallas row tile divides N
         self.num_data = dataset.num_data
         self.padded_rows = (-self.num_data) % 1024 if self.use_pallas else 0
-        self._upload_bins(dataset.binned)
+        self._upload_bins(dataset.binned if self.grouped or not dataset.is_bundled
+                          else dataset.unbundled_matrix())
 
     def _pad_host_rows(self, binned: np.ndarray) -> np.ndarray:
         if self.padded_rows:
@@ -725,7 +786,15 @@ class SerialTreeLearner:
             params=self.params, num_bins=self.num_bins,
             use_pallas=self.use_pallas,
             has_categorical=self.has_categorical,
-            has_monotone=self.has_monotone)
+            has_monotone=self.has_monotone,
+            feat_num_bins=self.feat_bins,
+            unpack_lanes=self.unpack_lanes)
+
+    def valid_bins(self, dataset: BinnedDataset) -> np.ndarray:
+        """Binned matrix of a validation set in this learner's layout."""
+        if self.grouped or not dataset.is_bundled:
+            return dataset.binned
+        return dataset.unbundled_matrix()
 
     # ---- host tree construction ----
 

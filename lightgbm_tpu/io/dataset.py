@@ -10,8 +10,14 @@ TPU-first departures from the reference layout:
 - No per-feature polymorphic ``Bin`` storage (dense/sparse/4-bit): the learner
   consumes one dense row-major matrix, the layout XLA/Pallas histogram kernels want.
   Sparsity is exploited by bin width (uint8 for <=256 bins) rather than by format.
-- Feature bundling (EFB, dataset.cpp:92-290) is represented as a host-side mapping
-  so the device matrix has one column per *group*; round 1 keeps group == feature.
+- Feature bundling (EFB, dataset.cpp:92-290 FindGroups/FastFeatureBundling) is a
+  host-side grouping: the device matrix has one column per *group*; group code 0
+  means "every bundled feature at its default bin" and feature ``f`` owns codes
+  ``[offset_f, offset_f + num_bin_f - 2]`` for its bins ``1..num_bin_f-1``.
+  Per-feature histograms are recovered by lane slicing + the FixHistogram
+  subtraction (dataset.h:501: default-bin stats = leaf totals - the rest).
+  Unbundled features are singleton groups with offset 1, which makes the
+  group code equal to the bin — the ungrouped layout is the special case.
 - Trivial features (single bin) are dropped from the device matrix and re-inserted
   at prediction time by index mapping, like the reference's used-feature mapping.
 """
@@ -42,6 +48,11 @@ class BinnedDataset:
         self.feature_names: List[str] = []
         self.raw_data: Optional[np.ndarray] = None   # kept for prediction paths
         self._device_cache = None
+        # EFB bundling (identity when every group is a singleton)
+        self.feature_groups: List[List[int]] = []    # used-col indices per group
+        self.group_idx: Optional[np.ndarray] = None  # [F_used] -> group column
+        self.bin_offset: Optional[np.ndarray] = None  # [F_used] first group code
+        self.num_bin_per_group: List[int] = []
 
     # ---- construction ----
 
@@ -55,7 +66,8 @@ class BinnedDataset:
                     forced_bins: Optional[Dict[int, List[float]]] = None,
                     max_bin_by_feature: Optional[Sequence[int]] = None,
                     reference: Optional["BinnedDataset"] = None,
-                    keep_raw: bool = True) -> "BinnedDataset":
+                    keep_raw: bool = True,
+                    enable_bundle: bool = True) -> "BinnedDataset":
         data = np.ascontiguousarray(data, dtype=np.float64)
         if data.ndim != 2:
             Log.fatal("Input data must be 2-dimensional")
@@ -100,15 +112,141 @@ class BinnedDataset:
         self.inner_feature_map = {f: j for j, f in enumerate(self.used_feature_idx)}
         self.num_bin_per_feature = [self.bin_mappers[i].num_bin
                                     for i in self.used_feature_idx]
-        max_nb = max(self.num_bin_per_feature, default=2)
-        dtype = np.uint8 if max_nb <= 256 else np.uint16
-        cols = [self.bin_mappers[i].values_to_bins(data[:, i]).astype(dtype)
+        col_dtype = (np.uint8 if max(self.num_bin_per_feature, default=2) <= 256
+                     else np.uint16)
+        cols = [self.bin_mappers[i].values_to_bins(data[:, i]).astype(col_dtype)
                 for i in self.used_feature_idx]
-        self.binned = (np.stack(cols, axis=1) if cols
-                       else np.zeros((self.num_data, 0), dtype=dtype))
+        if reference is not None:
+            self.feature_groups = [list(g) for g in reference.feature_groups]
+            self.group_idx = reference.group_idx
+            self.bin_offset = reference.bin_offset
+            self.num_bin_per_group = list(reference.num_bin_per_group)
+        else:
+            self.feature_groups = (self._find_groups(cols) if enable_bundle
+                                   else [[j] for j in range(len(cols))])
+            self._assign_group_layout()
+        self.binned = self._bundle_columns(cols)
         if keep_raw:
             self.raw_data = data
         return self
+
+    # ---- EFB bundling (dataset.cpp:92-290) ----
+
+    _EFB_SAMPLE = 65536
+
+    def _find_groups(self, cols: List[np.ndarray]) -> List[List[int]]:
+        """Greedy mutually-exclusive feature grouping (FindGroups,
+        dataset.cpp:92-215): a feature joins the first group whose conflict
+        count stays within the budget (total/10000, :104) and at most half the
+        feature's active rows (:143); group bin budget 256 (:103).  Tried in
+        both natural and active-count order, keeping the fewer groups
+        (FastFeatureBundling :215-290).  Only features whose default bin is 0
+        share the group's 0 code; others stay singletons."""
+        nf = len(cols)
+        if nf <= 1:
+            return [[j] for j in range(nf)]
+        n = self.num_data
+        if n > self._EFB_SAMPLE:
+            rng = np.random.RandomState(1)
+            rows = np.sort(rng.choice(n, self._EFB_SAMPLE, replace=False))
+        else:
+            rows = slice(None)
+        active = [np.asarray(c[rows] != 0) for c in cols]
+        counts = [int(a.sum()) for a in active]
+        total = active[0].shape[0] if nf else 0
+        budget = total // 10000
+        bundleable = [
+            self.bin_mappers[self.used_feature_idx[j]].default_bin == 0
+            and not self.bin_mappers[self.used_feature_idx[j]].is_trivial
+            for j in range(nf)]
+
+        def run(order):
+            groups: List[List[int]] = []
+            marks: List[np.ndarray] = []
+            conflict_used: List[int] = []
+            bins_used: List[int] = []
+            for j in order:
+                nb = self.num_bin_per_feature[j]
+                placed = False
+                if bundleable[j] and counts[j] * 2 <= total:
+                    for g in range(len(groups)):
+                        if bins_used[g] + nb - 1 > 255:
+                            continue
+                        rest = budget - conflict_used[g]
+                        if rest < 0:
+                            continue
+                        cnt = int((marks[g] & active[j]).sum())
+                        if cnt <= rest and cnt * 2 <= counts[j]:
+                            groups[g].append(j)
+                            marks[g] |= active[j]
+                            conflict_used[g] += cnt
+                            bins_used[g] += nb - 1
+                            placed = True
+                            break
+                if not placed:
+                    groups.append([j])
+                    marks.append(active[j].copy() if bundleable[j]
+                                 else np.ones_like(active[j]))
+                    conflict_used.append(0)
+                    bins_used.append(nb - 1 if bundleable[j] else 256)
+            return groups
+
+        natural = run(range(nf))
+        by_cnt = run(sorted(range(nf), key=lambda j: -counts[j]))
+        groups = by_cnt if len(by_cnt) < len(natural) else natural
+        return [sorted(g) for g in groups]
+
+    def _assign_group_layout(self) -> None:
+        nf = len(self.num_bin_per_feature)
+        self.group_idx = np.zeros(nf, dtype=np.int32)
+        self.bin_offset = np.zeros(nf, dtype=np.int32)
+        self.num_bin_per_group = []
+        for g, feats in enumerate(self.feature_groups):
+            off = 1
+            for j in feats:
+                self.group_idx[j] = g
+                self.bin_offset[j] = off
+                off += self.num_bin_per_feature[j] - 1
+            self.num_bin_per_group.append(off)
+
+    def _bundle_columns(self, cols: List[np.ndarray]) -> np.ndarray:
+        max_nb = max(self.num_bin_per_group, default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        if not cols:
+            return np.zeros((self.num_data, 0), dtype=dtype)
+        out = np.zeros((self.num_data, len(self.feature_groups)), dtype=dtype)
+        for g, feats in enumerate(self.feature_groups):
+            if len(feats) == 1:
+                out[:, g] = cols[feats[0]].astype(dtype)
+                continue
+            gcol = np.zeros(self.num_data, dtype=np.int32)
+            for j in feats:   # push order: later features win conflicts
+                b = cols[j]
+                nz = b != 0
+                gcol[nz] = self.bin_offset[j] + b[nz] - 1
+            out[:, g] = gcol.astype(dtype)
+        return out
+
+    @property
+    def is_bundled(self) -> bool:
+        return len(self.feature_groups) < len(self.used_feature_idx)
+
+    def unbundled_matrix(self) -> np.ndarray:
+        """Per-feature [N, F_used] bin matrix (for learners that shard over
+        features and want one column per feature)."""
+        if not self.is_bundled:
+            return self.binned
+        nf = len(self.used_feature_idx)
+        max_nb = max(self.num_bin_per_feature, default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        out = np.zeros((self.num_data, nf), dtype=dtype)
+        for j in range(nf):
+            col = self.binned[:, self.group_idx[j]].astype(np.int32)
+            off = int(self.bin_offset[j])
+            nb = self.num_bin_per_feature[j]
+            mine = (col >= off) & (col <= off + nb - 2)
+            out[mine, j] = (col[mine] - off + 1).astype(dtype)
+        return out
 
     def _find_bin_mappers(self, data, max_bin, min_data_in_bin, min_data_in_leaf,
                           sample_cnt, categorical_feature, use_missing,
@@ -164,6 +302,11 @@ class BinnedDataset:
     def max_num_bin(self) -> int:
         return max(self.num_bin_per_feature, default=2)
 
+    @property
+    def max_group_bin(self) -> int:
+        return max(self.num_bin_per_group or self.num_bin_per_feature,
+                   default=2)
+
     def most_freq_bins(self) -> np.ndarray:
         return np.asarray([self.bin_mappers[i].most_freq_bin
                            for i in self.used_feature_idx], dtype=np.int32)
@@ -194,6 +337,7 @@ class BinnedDataset:
             "has_group": self.metadata.query_boundaries is not None,
             "has_init_score": self.metadata.init_score is not None,
             "binned_dtype": str(self.binned.dtype),
+            "feature_groups": self.feature_groups,
         }
         with open(path, "wb") as fh:
             fh.write(self.MAGIC)
@@ -237,6 +381,9 @@ class BinnedDataset:
         self.inner_feature_map = {f: j for j, f in enumerate(self.used_feature_idx)}
         self.num_bin_per_feature = [self.bin_mappers[i].num_bin
                                     for i in self.used_feature_idx]
+        self.feature_groups = [list(g) for g in header.get(
+            "feature_groups", [[j] for j in range(len(self.used_feature_idx))])]
+        self._assign_group_layout()
         self.metadata._update_query_weights()
         return self
 
@@ -251,6 +398,10 @@ class BinnedDataset:
         out.inner_feature_map = self.inner_feature_map
         out.num_bin_per_feature = self.num_bin_per_feature
         out.feature_names = self.feature_names
+        out.feature_groups = self.feature_groups
+        out.group_idx = self.group_idx
+        out.bin_offset = self.bin_offset
+        out.num_bin_per_group = self.num_bin_per_group
         out.binned = self.binned[indices]
         out.metadata = self.metadata.subset(indices)
         if self.raw_data is not None:

@@ -24,6 +24,9 @@ class ObjectiveFunction:
     is_constant_hessian: bool = False
     need_accurate_prediction: bool = True
     is_renew_tree_output: bool = False
+    # False for objectives that draw fresh randomness per GetGradients call
+    # (they must not be traced once and replayed by fused training)
+    deterministic_gradients: bool = True
 
     def __init__(self, config) -> None:
         self.config = config

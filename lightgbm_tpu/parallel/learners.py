@@ -43,6 +43,7 @@ class _ParallelTreeLearner(SerialTreeLearner):
     """Shared host wrapper: padding to mesh-divisible shapes + shard_map build."""
 
     mode = "data_rs"
+    supports_groups = False  # feature sharding wants one column per feature
 
     def __init__(self, dataset, config, mesh: Optional[Mesh] = None) -> None:
         super().__init__(dataset, config)
