@@ -221,6 +221,9 @@ class GBDT:
         self.train_data = train_data
         self.objective = objective
         self.num_data = train_data.num_data
+        # cached fused programs close over the old learner/objective
+        self._fused_cache = {}
+        self._fuse_failed = False
         self.num_tree_per_iteration = (objective.num_model_per_iteration
                                        if objective else max(1, self.num_class))
         self.learner = create_tree_learner(train_data, self.config,
@@ -534,6 +537,8 @@ class GBDT:
             return False
         if getattr(self.learner, "comm", None) is not None:
             return False  # parallel learners keep the per-iteration path
+        if getattr(self.learner, "cegb", None) is not None:
+            return False  # CEGB carries feature-used state across iterations
         if self._fuse_failed:
             return False
         return True
@@ -556,7 +561,8 @@ class GBDT:
                       has_categorical=learner.has_categorical,
                       has_monotone=learner.has_monotone,
                       feat_num_bins=learner.feat_bins,
-                      unpack_lanes=learner.unpack_lanes)
+                      unpack_lanes=learner.unpack_lanes,
+                      forced=learner.forced)
 
         def one_iter(score, _):
             live = score[:, :n]

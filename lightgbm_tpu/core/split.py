@@ -138,7 +138,8 @@ def _split_gains(gl, hl, gr, hr, p: SplitParams):
 def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array,
                      sum_grad: jax.Array, sum_hess: jax.Array,
                      num_data: jax.Array, params: SplitParams,
-                     cmin=None, cmax=None) -> FeatureBest:
+                     cmin=None, cmax=None,
+                     threshold_mask=None) -> FeatureBest:
     """Best numerical split of EACH feature of one leaf (all outputs [F]).
 
     hist: [F, 2, B] f32; feature_mask: [F] bool (feature_fraction);
@@ -146,6 +147,9 @@ def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array
     monotone-constraint bounds (monotone_constraints.hpp ConstraintEntry) —
     outputs are clamped into [cmin, cmax] and candidates on monotone features
     that violate the ordering are discarded (feature_histogram.hpp:468-527).
+    ``threshold_mask`` [B] restricts the candidate thresholds — used to gather
+    the stats of one FORCED threshold (feature_histogram.hpp:306
+    GatherInfoForThreshold).
     """
     F, _, B = hist.shape
     g = hist[:, 0, :]
@@ -229,6 +233,9 @@ def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array
         ok &= gain > min_gain_shift
         return jnp.where(ok, gain, K_MIN_SCORE), lo, ro
 
+    if threshold_mask is not None:
+        valid0 = valid0 & threshold_mask[None, :]
+        valid1 = valid1 & threshold_mask[None, :]
     gain0, lo0, ro0 = evaluate(left_g0, left_h0, left_c0,
                                right_g0, right_h0, right_c0, valid0)
     gain1, lo1, ro1 = evaluate(left_g1, left_h1, left_c1,

@@ -399,6 +399,10 @@ class _PState(NamedTuple):
     binsp: jax.Array            # [N, F] bins, leaf-partitioned
     valsp: jax.Array            # [N, 2] (grad, hess), leaf-partitioned
     order: jax.Array            # [N] i32: position -> original row
+    lsum_g: jax.Array           # [L] leaf gradient totals (forced splits)
+    lsum_h: jax.Array           # [L] leaf hessian totals
+    feat_used: jax.Array        # [F] bool: feature split somewhere (CEGB)
+    force_on: jax.Array         # scalar bool: forced schedule still aligned
 
 
 def _ffill_nonzero(x: jax.Array) -> jax.Array:
@@ -425,7 +429,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            has_categorical: bool = False,
                            has_monotone: bool = False,
                            feat_num_bins: int = 0,
-                           unpack_lanes=None) -> TreeArrays:
+                           unpack_lanes=None,
+                           forced=None, cegb=None) -> TreeArrays:
     """Leaf-wise growth with per-leaf physical row partitions.
 
     The TPU counterpart of the reference's ``DataPartition``
@@ -437,6 +442,18 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     Identical split semantics to :func:`build_tree`, ~num_leaves× less
     histogram streaming on deep trees.  Single-shard only — the parallel modes
     use :func:`build_tree`.
+
+    ``forced``: optional (leaf_ids [S], features [S], threshold_bins [S]) BFS
+    schedule of forced splits (serial_tree_learner.cpp:458 ForceSplits) — the
+    first S splits are taken at those positions when valid, stats gathered at
+    the given threshold; growth then continues best-first.
+    ``cegb``: optional (penalty_split [scalar], coupled [F], used0 [F]) cost
+    penalties (cost_effective_gradient_boosting.hpp:50-61 DetlaGain):
+    candidate gains lose tradeoff*penalty_split*num_data_in_leaf plus the
+    coupled per-feature penalty until the feature's first use.  Unlike the
+    reference — which refunds cached candidate gains of other leaves when a
+    feature becomes used (:63-79 UpdateLeafBestSplits) — cached leaf bests
+    here keep their original penalty until the leaf is re-evaluated.
     """
     n, ncols = bins.shape
     f = feat.num_bin.shape[0]          # features may outnumber group columns
@@ -459,15 +476,62 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return hf.at[:, 0, 0].set(sg - rest[:, 0]).at[:, 1, 0].set(
             sh - rest[:, 1])
 
-    def best_of(h, sg, sh, cnt, cmn, cmx):
+    def best_of(h, sg, sh, cnt, cmn, cmx, used=None):
         fb = per_feature_best_combined(
             unpack(h, sg, sh), feat, feature_mask, sg, sh, cnt, params,
             any_categorical=has_categorical,
             cmin=cmn if has_monotone else None,
             cmax=cmx if has_monotone else None)
+        if cegb is not None:
+            split_pen, coupled, _ = cegb
+            penalty = (split_pen * cnt.astype(jnp.float32)
+                       + jnp.where(used, 0.0, coupled))
+            fb = fb._replace(gain=jnp.where(fb.gain > K_MIN_SCORE,
+                                            fb.gain - penalty, fb.gain))
         return reduce_feature_best(fb, jnp.arange(f, dtype=jnp.int32))
 
-    vmapped_best = jax.vmap(best_of)
+    def unpack_one(h, ffeat, sg, sh):
+        """One feature's [1, 2, B] histogram from a group-column block
+        (avoids unpacking all F features in the growth loop)."""
+        if unpack_lanes is None:
+            return jax.lax.dynamic_index_in_dim(h, ffeat, axis=0)
+        lidx, lmask = unpack_lanes
+        hg = jax.lax.dynamic_index_in_dim(h, feat.group[ffeat], axis=0,
+                                          keepdims=False)      # [2, Bg]
+        hf = jnp.take(hg, lidx[ffeat], axis=1) * lmask[ffeat][None, :]
+        rest = jnp.sum(hf, axis=1)
+        return hf.at[0, 0].set(sg - rest[0]).at[1, 0].set(
+            sh - rest[1])[None]
+
+    def forced_best(st, k):
+        """Stats of the k-th forced split (GatherInfoForThreshold semantics):
+        per_feature_best with the candidate set restricted to one threshold.
+        Valid only while every earlier forced split applied (st.force_on) —
+        otherwise leaf ids in the schedule no longer line up."""
+        s_max = forced[0].shape[0]
+        idx = jnp.minimum(k - 1, s_max - 1)
+        fleaf = forced[0][idx]
+        ffeat = forced[1][idx]
+        fthr = forced[2][idx]
+        sg = st.lsum_g[fleaf]
+        sh = st.lsum_h[fleaf]
+        cnt = st.tree.leaf_count[fleaf]
+        hf = unpack_one(st.hist[fleaf], ffeat, sg, sh)
+        feat1 = FeatureInfo(*[None if a is None else
+                              jax.lax.dynamic_index_in_dim(a, ffeat)
+                              for a in feat])
+        tmask = jnp.arange(B, dtype=jnp.int32) == fthr
+        fb = per_feature_best(hf, feat1, jnp.ones((1,), bool), sg, sh, cnt,
+                              params,
+                              cmin=st.cmin[fleaf] if has_monotone else None,
+                              cmax=st.cmax[fleaf] if has_monotone else None,
+                              threshold_mask=tmask)
+        best = reduce_feature_best(fb, ffeat[None])
+        valid = (k <= s_max) & (best.gain > K_MIN_SCORE) & st.force_on
+        in_sched = k <= s_max
+        return fleaf, best, valid, in_sched
+
+    vmapped_best = jax.vmap(best_of, in_axes=(0, 0, 0, 0, 0, 0, None))
 
     def make_branch(R):
         """Partition the parent window (size <= R) and histogram the smaller
@@ -526,7 +590,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     sum_h = jnp.sum(hess)
     no_min = jnp.float32(-np.inf)
     no_max = jnp.float32(np.inf)
-    best0 = best_of(hist0, sum_g, sum_h, num_data, no_min, no_max)
+    used0 = (cegb[2] if cegb is not None else jnp.zeros((f,), bool))
+    best0 = best_of(hist0, sum_g, sum_h, num_data, no_min, no_max, used0)
 
     def zl(dtype=f32):
         return jnp.zeros((L,), dtype=dtype)
@@ -551,7 +616,11 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     begin=zl(jnp.int32),
                     wcount=zl(jnp.int32).at[0].set(n),
                     binsp=bins, valsp=values,
-                    order=jnp.arange(n, dtype=jnp.int32))
+                    order=jnp.arange(n, dtype=jnp.int32),
+                    lsum_g=zl().at[0].set(sum_g),
+                    lsum_h=zl().at[0].set(sum_h),
+                    feat_used=used0,
+                    force_on=jnp.bool_(True))
 
     def body(k, st: _PState) -> _PState:
         node = k - 1
@@ -561,10 +630,22 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             gains = jnp.where(t.leaf_depth < max_depth, gains, K_MIN_SCORE)
         leaf = jnp.argmax(gains).astype(jnp.int32)
         ok = (gains[leaf] > 0.0) & st.cont
+        force_now = None
+        if forced is not None:
+            fleaf, fbest, fvalid, in_sched = forced_best(st, k)
+            leaf = jnp.where(fvalid, fleaf, leaf)
+            ok = jnp.where(fvalid, st.cont, ok)
+            force_now = (fbest, fvalid)
+            # one failed entry invalidates the rest of the schedule's leaf ids
+            st = st._replace(force_on=st.force_on & (~in_sched | fvalid))
 
         def do_split(st: _PState) -> _PState:
             t = st.tree
             b = BestSplit(*[x[leaf] for x in st.bests])
+            if force_now is not None:
+                fbest, fvalid = force_now
+                b = BestSplit(*[jnp.where(fvalid, fx, x)
+                                for fx, x in zip(fbest, b)])
             wb, wc = st.begin[leaf], st.wcount[leaf]
             which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
             binsp, valsp, order, hist_small, nl, left_smaller = jax.lax.switch(
@@ -596,12 +677,15 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             cmin_new = st.cmin.at[leaf].set(lmin).at[k].set(rmin)
             cmax_new = st.cmax.at[leaf].set(lmax).at[k].set(rmax)
 
+            feat_used = (st.feat_used | (jnp.arange(f) == b.feature)
+                         if cegb is not None else st.feat_used)
             child_best = vmapped_best(
                 jnp.stack([hist_left, hist_right]),
                 jnp.stack([b.left_sum_grad, b.right_sum_grad]),
                 jnp.stack([b.left_sum_hess, b.right_sum_hess]),
                 jnp.stack([b.left_count, b.right_count]),
-                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]))
+                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+                feat_used)
             bests = _bests_update(st.bests, leaf,
                                   BestSplit(*[x[0] for x in child_best]))
             bests = _bests_update(bests, k, BestSplit(*[x[1] for x in child_best]))
@@ -640,10 +724,16 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 cat_bitset=t.cat_bitset.at[node].set(b.cat_bitset),
                 num_leaves=t.num_leaves + 1,
                 row_leaf=t.row_leaf)
+            lsum_g = st.lsum_g.at[leaf].set(b.left_sum_grad).at[k].set(
+                b.right_sum_grad)
+            lsum_h = st.lsum_h.at[leaf].set(b.left_sum_hess).at[k].set(
+                b.right_sum_hess)
             return _PState(tree=tree_new, hist=hist_new, bests=bests,
                            cont=st.cont, cmin=cmin_new, cmax=cmax_new,
                            begin=begin, wcount=wcount,
-                           binsp=binsp, valsp=valsp, order=order)
+                           binsp=binsp, valsp=valsp, order=order,
+                           lsum_g=lsum_g, lsum_h=lsum_h, feat_used=feat_used,
+                           force_on=st.force_on)
 
         return jax.lax.cond(ok, do_split,
                             lambda s: s._replace(cont=jnp.bool_(False)), st)
@@ -751,6 +841,73 @@ class SerialTreeLearner:
         self.padded_rows = (-self.num_data) % 1024 if self.use_pallas else 0
         self._upload_bins(dataset.binned if self.grouped or not dataset.is_bundled
                           else dataset.unbundled_matrix())
+        self.forced = self._load_forced_splits(config, dataset)
+        self.cegb = self._init_cegb(config, dataset)
+        self.cegb_used = (jnp.zeros((dataset.num_features,), bool)
+                          if self.cegb is not None else None)
+
+    def _load_forced_splits(self, config, dataset):
+        """BFS schedule from forcedsplits_filename
+        (serial_tree_learner.cpp:458 ForceSplits; numerical splits only)."""
+        fname = str(getattr(config, "forcedsplits_filename", "") or "")
+        if not fname:
+            return None
+        import json as _json
+        import os as _os
+        if not _os.path.exists(fname):
+            from ..utils.log import Log
+            Log.warning("Forced splits file %s does not exist", fname)
+            return None
+        with open(fname) as fh:
+            spec = _json.load(fh)
+        sched = []
+        queue = [(spec, 0)]
+        while queue and len(sched) < self.num_leaves - 1:
+            node, leaf = queue.pop(0)
+            orig = int(node.get("feature", -1))
+            inner = dataset.inner_feature_map.get(orig)
+            if inner is None or \
+                    dataset.bin_mappers[orig].bin_type == BinType.CATEGORICAL:
+                from ..utils.log import Log
+                Log.warning("Forced split on unusable feature %d; dropping the "
+                            "rest of the forced-splits schedule", orig)
+                break
+            thr_bin = int(dataset.bin_mappers[orig].values_to_bins(
+                np.asarray([float(node["threshold"])]))[0])
+            step = len(sched) + 1
+            sched.append((leaf, inner, thr_bin))
+            if "left" in node:
+                queue.append((node["left"], leaf))
+            if "right" in node:
+                queue.append((node["right"], step))
+        if not sched:
+            return None
+        arr = np.asarray(sched, dtype=np.int32)
+        return (jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]),
+                jnp.asarray(arr[:, 2]))
+
+    def _init_cegb(self, config, dataset):
+        """(tradeoff*penalty_split, tradeoff*coupled [F]) when CEGB is active
+        (cost_effective_gradient_boosting.hpp:25-31 IsEnable)."""
+        tr = float(config.cegb_tradeoff)
+        ps = float(config.cegb_penalty_split)
+        coupled_cfg = list(config.cegb_penalty_feature_coupled or [])
+        lazy_cfg = list(config.cegb_penalty_feature_lazy or [])
+        if lazy_cfg and any(v != 0 for v in lazy_cfg):
+            from ..utils.log import Log
+            Log.warning("cegb_penalty_feature_lazy is not supported on the "
+                        "TPU learner; the per-row on-demand cost is ignored")
+        if ps <= 0.0 and not any(coupled_cfg):
+            return None
+        if coupled_cfg and len(coupled_cfg) != dataset.num_total_features:
+            from ..utils.log import Log
+            Log.fatal("cegb_penalty_feature_coupled should be the same size "
+                      "as feature number.")
+        coupled = np.zeros(dataset.num_features, dtype=np.float32)
+        for j, orig in enumerate(dataset.used_feature_idx):
+            if orig < len(coupled_cfg):
+                coupled[j] = tr * float(coupled_cfg[orig])
+        return (jnp.float32(tr * ps), jnp.asarray(coupled))
 
     def _pad_host_rows(self, binned: np.ndarray) -> np.ndarray:
         if self.padded_rows:
@@ -778,7 +935,9 @@ class SerialTreeLearner:
             feature_mask = jnp.ones((self.dataset.num_features,), dtype=bool)
         grad = self.pad_rows(grad)
         hess = self.pad_rows(hess)
-        return build_tree_partitioned(
+        cegb = (None if self.cegb is None
+                else (self.cegb[0], self.cegb[1], self.cegb_used))
+        arrays = build_tree_partitioned(
             self.bins, grad, hess,
             jnp.asarray(num_data_in_bag, dtype=jnp.int32),
             feature_mask, self.feat,
@@ -788,7 +947,14 @@ class SerialTreeLearner:
             has_categorical=self.has_categorical,
             has_monotone=self.has_monotone,
             feat_num_bins=self.feat_bins,
-            unpack_lanes=self.unpack_lanes)
+            unpack_lanes=self.unpack_lanes,
+            forced=self.forced, cegb=cegb)
+        if self.cegb is not None:
+            # persist feature-used state across trees
+            # (is_feature_used_in_split_ lives for the whole training)
+            valid = jnp.arange(self.num_leaves) < (arrays.num_leaves - 1)
+            self.cegb_used = self.cegb_used.at[arrays.split_feature].max(valid)
+        return arrays
 
     def valid_bins(self, dataset: BinnedDataset) -> np.ndarray:
         """Binned matrix of a validation set in this learner's layout."""

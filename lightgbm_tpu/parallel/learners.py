@@ -47,6 +47,11 @@ class _ParallelTreeLearner(SerialTreeLearner):
 
     def __init__(self, dataset, config, mesh: Optional[Mesh] = None) -> None:
         super().__init__(dataset, config)
+        if self.forced is not None or self.cegb is not None:
+            from ..utils.log import Log
+            Log.warning("forced splits / CEGB penalties are only applied by "
+                        "the serial tree learner; tree_learner=%s ignores "
+                        "them", self.mode)
         self.mesh = mesh if mesh is not None else default_mesh()
         self.num_shards = int(np.prod(self.mesh.devices.shape))
         self.axis = self.mesh.axis_names[0]

@@ -1,0 +1,100 @@
+"""Forced splits (serial_tree_learner.cpp:458 ForceSplits) and CEGB gain
+penalties (cost_effective_gradient_boosting.hpp:21-120)."""
+import json
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(13)
+    n = 5000
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.7 * X[:, 1] + 0.5 * X[:, 2] + 0.4 * X[:, 3]
+         + rng.normal(scale=0.4, size=n))
+    return X, y
+
+
+def _train(X, y, **params):
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    cfg = Config(dict(objective="regression", num_leaves=15, num_iterations=8,
+                      learning_rate=0.2, max_bin=63, **params))
+    b = GBDT(cfg, ds, create_objective("regression", cfg))
+    for _ in range(8):
+        b.train_one_iter()
+    return b
+
+
+def test_forced_splits_respected(data, tmp_path):
+    X, y = data
+    spec = {"feature": 5, "threshold": 0.25,
+            "left": {"feature": 4, "threshold": -0.5}}
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(spec))
+    b = _train(X, y, forcedsplits_filename=str(path))
+    for tree in b.models:
+        # node 0 must split feature 5 at ~0.25; node of second split forces
+        # feature 4 on the LEFT child of the root
+        assert tree.split_feature[0] == 5
+        assert abs(tree.threshold[0] - 0.25) < 0.2
+        assert tree.split_feature[1] == 4
+        # second forced split hangs off the root's left side
+        assert tree.left_child[0] == 1
+    # quality should stay sane despite the forced structure
+    score = np.asarray(b.train_score[0, :len(y)])
+    base = _train(X, y)
+    mse_forced = np.mean((score - y) ** 2)
+    mse_base = np.mean(
+        (np.asarray(base.train_score[0, :len(y)]) - y) ** 2)
+    assert mse_forced < np.var(y)          # learned something
+    assert mse_forced >= mse_base * 0.9    # but not better than free growth
+
+
+def test_forced_splits_fused_path_matches(data, tmp_path):
+    X, y = data
+    spec = {"feature": 5, "threshold": 0.25}
+    path = tmp_path / "forced1.json"
+    path.write_text(json.dumps(spec))
+    b1 = _train(X, y, forcedsplits_filename=str(path))
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    cfg = Config(objective="regression", num_leaves=15, num_iterations=8,
+                 learning_rate=0.2, max_bin=63,
+                 forcedsplits_filename=str(path))
+    b2 = GBDT(cfg, ds, create_objective("regression", cfg))
+    assert b2._can_fuse_iters()
+    b2.train_chunk(8)
+    # the fused scan may compile float reductions in a different order than
+    # the standalone build, so later trees can drift in ulps; the forced
+    # structure and the fit must match
+    for tree in b2.models:
+        assert tree.split_feature[0] == 5
+    p1 = b1.predict(X[:1000])
+    p2 = b2.predict(X[:1000])
+    np.testing.assert_allclose(p1, p2, rtol=1e-3, atol=1e-4)
+
+
+def test_cegb_split_penalty_shrinks_trees(data):
+    X, y = data
+    base = _train(X, y)
+    pen = _train(X, y, cegb_penalty_split=0.05)
+    n_base = sum(t.num_leaves for t in base.models)
+    n_pen = sum(t.num_leaves for t in pen.models)
+    assert n_pen < n_base
+
+
+def test_cegb_coupled_penalty_narrows_features(data):
+    X, y = data
+    base = _train(X, y)
+    # make features 2..5 expensive: the model should lean on 0 and 1
+    coupled = [0.0, 0.0, 1e4, 1e4, 1e4, 1e4]
+    pen = _train(X, y, cegb_penalty_feature_coupled=coupled)
+    imp_base = base.feature_importance("split")
+    imp_pen = pen.feature_importance("split")
+    assert imp_pen[2:].sum() < imp_base[2:].sum()
+    assert imp_pen[:2].sum() > 0
