@@ -19,6 +19,7 @@ TPU-first notes:
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -453,7 +454,8 @@ class GBDT:
         if gradients is None or hessians is None:
             for k in range(K):
                 init_scores[k] = self._boost_from_average(k, True)
-            grad, hess = self._get_gradients()
+            with FunctionTimer("GBDT::Boosting(dispatch)"):
+                grad, hess = self._get_gradients()
         else:
             grad = jnp.asarray(np.asarray(gradients, dtype=np.float32)).reshape(
                 K, self.num_data)
@@ -473,16 +475,18 @@ class GBDT:
                 if self.bag_mask is not None:
                     gk = gk * self.bag_mask
                     hk = hk * self.bag_mask
-                arrays = self.learner.train(gk, hk, self.bag_data_cnt,
-                                            feature_mask)
+                with FunctionTimer("TreeLearner::Train(dispatch)"):
+                    arrays = self.learner.train(gk, hk, self.bag_data_cnt,
+                                                feature_mask)
                 rate = self.shrinkage_rate
                 scaled = arrays._replace(
                     leaf_value=arrays.leaf_value * rate,
                     internal_value=arrays.internal_value * rate)
-                self.train_score = self.train_score.at[k].add(
-                    self._gather_tree_output(scaled))
-                for vs in self.valid_sets:
-                    self._route_arrays_valid(scaled, k, vs)
+                with FunctionTimer("GBDT::UpdateScore(dispatch)"):
+                    self.train_score = self.train_score.at[k].add(
+                        self._gather_tree_output(scaled))
+                    for vs in self.valid_sets:
+                        self._route_arrays_valid(scaled, k, vs)
                 idx = len(self._models)
                 self._models.append(None)
                 self._pending[idx] = (scaled, init_scores[k])
@@ -613,7 +617,9 @@ class GBDT:
             self._fused_cache[key] = fn
         init_scores = [self._boost_from_average(kk, True)
                        for kk in range(self.num_tree_per_iteration)]
-        new_score, stacked = fn(self.train_score)
+        t0 = time.perf_counter()
+        with FunctionTimer("GBDT::TrainChunk(dispatch)"):
+            new_score, stacked = fn(self.train_score)
         self.train_score = new_score
         K = self.num_tree_per_iteration
         first_idx = len(self._models)
@@ -631,6 +637,8 @@ class GBDT:
         self._last_iter_arrays = [_LazyTreeSlice(stacked[kk], num_iters - 1)
                                   for kk in range(K)]
         self.iter_ += num_iters
+        Log.debug("%f seconds elapsed, dispatched iterations %d-%d",
+                  time.perf_counter() - t0, first_iter + 1, self.iter_)
         if self.iter_ - self._last_poll >= self._poll_freq:
             return self._poll_stop()
         return False
@@ -857,6 +865,7 @@ class GBDT:
     # ---- training driver with internal early stopping (CLI path) ----
 
     def train(self, snapshot_out: Optional[str] = None) -> None:
+        t_start = time.perf_counter()
         total = int(self.config.num_iterations)
         has_eval = bool(self.train_metrics) or bool(self.valid_sets)
         mf = int(self.config.metric_freq)
@@ -872,6 +881,8 @@ class GBDT:
             if snapshot_out and sf > 0:
                 nxt = min(nxt, it + sf - (it % sf))
             finished = self.train_chunk(min(nxt - it, chunk_cap))
+            Log.info("%f seconds elapsed, finished iteration %d",
+                     time.perf_counter() - t_start, self.iter_)
             if not finished and has_eval and mf > 0 \
                     and self.iter_ % mf == 0:
                 finished = self.eval_and_check_early_stopping()

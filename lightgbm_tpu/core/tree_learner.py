@@ -528,6 +528,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               threshold_mask=tmask)
         best = reduce_feature_best(fb, ffeat[None])
         valid = (k <= s_max) & (best.gain > K_MIN_SCORE) & st.force_on
+        if max_depth > 0:   # forced splits still honor the depth cap
+            valid = valid & (st.tree.leaf_depth[fleaf] < max_depth)
         in_sched = k <= s_max
         return fleaf, best, valid, in_sched
 

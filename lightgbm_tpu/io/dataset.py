@@ -67,7 +67,9 @@ class BinnedDataset:
                     max_bin_by_feature: Optional[Sequence[int]] = None,
                     reference: Optional["BinnedDataset"] = None,
                     keep_raw: bool = True,
-                    enable_bundle: bool = True) -> "BinnedDataset":
+                    enable_bundle: bool = True,
+                    bin_mappers: Optional[List[BinMapper]] = None
+                    ) -> "BinnedDataset":
         data = np.ascontiguousarray(data, dtype=np.float64)
         if data.ndim != 2:
             Log.fatal("Input data must be 2-dimensional")
@@ -101,6 +103,13 @@ class BinnedDataset:
                           self.num_total_features, reference.num_total_features)
             self.bin_mappers = reference.bin_mappers
             self.feature_names = reference.feature_names
+        elif bin_mappers is not None:
+            # injected (e.g. distributed bin finding's allgather-merged set,
+            # dataset_loader.cpp:1028)
+            if len(bin_mappers) != self.num_total_features:
+                Log.fatal("Got %d bin mappers for %d features",
+                          len(bin_mappers), self.num_total_features)
+            self.bin_mappers = list(bin_mappers)
         else:
             self._find_bin_mappers(data, max_bin, min_data_in_bin, min_data_in_leaf,
                                    bin_construct_sample_cnt, categorical_feature,
