@@ -357,7 +357,8 @@ class GBDT:
             leaf = dev.row_leaf
         else:
             dev = self._tree_to_device(tree)
-            leaf = route_binned(self.learner.bins, dev, self.learner.feat,
+            leaf = route_binned(self.learner.route_bins_matrix(), dev,
+                                self.learner.feat,
                                 num_leaves=int(self.config.num_leaves))
         vals = jnp.asarray(
             np.concatenate([tree.leaf_value[:tree.num_leaves],
@@ -566,7 +567,8 @@ class GBDT:
                       has_monotone=learner.has_monotone,
                       feat_num_bins=learner.feat_bins,
                       unpack_lanes=learner.unpack_lanes,
-                      forced=learner.forced)
+                      forced=learner.forced,
+                      packed_cols=learner.packed_cols)
 
         def one_iter(score, _):
             live = score[:, :n]

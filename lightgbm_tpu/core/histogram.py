@@ -113,7 +113,8 @@ def build_histogram(bins: jax.Array, values: jax.Array, num_bins: int,
 
 
 def _hist_kernel_masked(win_ref, bins_ref, vals_ref, out_ref, *,
-                        num_features: int, num_bins: int, row_tile: int):
+                        num_features: int, num_bins: int, row_tile: int,
+                        packed: bool):
     """Histogram of the rows in [win[0], win[0]+win[1]) of its input slice.
 
     The TPU analogue of the reference's per-leaf ordered-index histogram
@@ -121,7 +122,8 @@ def _hist_kernel_masked(win_ref, bins_ref, vals_ref, out_ref, *,
     the caller slices a bucket-sized window of the leaf-partitioned matrix,
     this kernel masks boundary-tile rows outside the leaf's exact window, and
     tiles fully outside skip compute — cost scales with the leaf's row count,
-    not the dataset size."""
+    not the dataset size.  ``packed`` reads 4-bit nibble pairs
+    (dense_nbits_bin.hpp storage: two <=16-bin columns per byte)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -139,42 +141,73 @@ def _hist_kernel_masked(win_ref, bins_ref, vals_ref, out_ref, *,
         vals = vals_ref[...] * in_w
         iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
         for f in range(num_features):
-            onehot = (bins[:, f:f + 1] == iota).astype(jnp.float32)
+            if packed:
+                col = (bins[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
+            else:
+                col = bins[:, f:f + 1]
+            onehot = (col == iota).astype(jnp.float32)
             acc = jax.lax.dot_general(vals, onehot, (((0,), (0,)), ((), ())),
                                       precision=jax.lax.Precision.HIGHEST,
                                       preferred_element_type=jnp.float32)
             out_ref[f, :, :] += acc
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile"))
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
+                                             "num_cols", "interpret"))
 def histogram_pallas_masked(bins: jax.Array, values: jax.Array, num_bins: int,
                             start: jax.Array, count: jax.Array,
-                            row_tile: int = 1024) -> jax.Array:
+                            row_tile: int = 1024, num_cols: int = 0,
+                            interpret: bool = False) -> jax.Array:
     """Histogram over rows [start, start+count) of a (bucket-sized) slice.
 
-    bins: [R, F] int; values: [R, 2] f32 (NOT pre-masked); start/count: i32
-    scalars relative to the slice.  R must be a multiple of row_tile."""
-    n, f = bins.shape
+    bins: [R, F] int (or [R, ceil(F/2)] nibble-packed when ``num_cols`` = F);
+    values: [R, 2] f32 (NOT pre-masked); start/count: i32 scalars relative to
+    the slice.  R must be a multiple of row_tile."""
+    n, width = bins.shape
+    f = num_cols or width
     assert n % row_tile == 0, "pad rows to a multiple of row_tile"
     win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
     kernel = functools.partial(_hist_kernel_masked, num_features=f,
-                               num_bins=num_bins, row_tile=row_tile)
+                               num_bins=num_bins, row_tile=row_tile,
+                               packed=bool(num_cols))
     return pl.pallas_call(
         kernel,
         grid=(n // row_tile,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((row_tile, f), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, width), lambda i: (i, 0)),
             pl.BlockSpec((row_tile, 2), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((f, 2, num_bins), lambda i: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((f, 2, num_bins), jnp.float32),
+        interpret=interpret,
     )(win, bins, values)
 
 
+def unpack_nibbles(packed: jax.Array, num_cols: int) -> jax.Array:
+    """[N, ceil(C/2)] nibble-packed u8 -> [N, C] bin codes."""
+    lo = packed & 15
+    hi = (packed >> 4) & 15
+    out = jnp.stack([lo, hi], axis=2).reshape(packed.shape[0], -1)
+    return out[:, :num_cols]
+
+
+def pack_nibbles(bins) -> "np.ndarray":
+    """Host: [N, C] codes (< 16) -> [N, ceil(C/2)] nibble-packed u8."""
+    import numpy as np
+    bins = np.asarray(bins, dtype=np.uint8)
+    n, c = bins.shape
+    if c % 2:
+        bins = np.concatenate([bins, np.zeros((n, 1), np.uint8)], axis=1)
+    return (bins[:, 0::2] | (bins[:, 1::2] << 4)).astype(np.uint8)
+
+
 def histogram_xla_masked(bins: jax.Array, values: jax.Array, num_bins: int,
-                         start: jax.Array, count: jax.Array) -> jax.Array:
+                         start: jax.Array, count: jax.Array,
+                         num_cols: int = 0) -> jax.Array:
     """Backend-agnostic masked histogram over a slice (full scan)."""
+    if num_cols:
+        bins = unpack_nibbles(bins, num_cols)
     pos = jnp.arange(bins.shape[0], dtype=jnp.int32)
     in_w = ((pos >= start) & (pos < start + count)).astype(values.dtype)
     return histogram_xla(bins, values * in_w[:, None], num_bins)
@@ -182,13 +215,18 @@ def histogram_xla_masked(bins: jax.Array, values: jax.Array, num_bins: int,
 
 def build_histogram_masked(bins: jax.Array, values: jax.Array, num_bins: int,
                            start: jax.Array, count: jax.Array,
-                           use_pallas: bool | None = None) -> jax.Array:
-    """Masked-histogram dispatch: Pallas on TPU, masked segment-sum off."""
+                           use_pallas: bool | None = None,
+                           num_cols: int = 0) -> jax.Array:
+    """Masked-histogram dispatch: Pallas on TPU, masked segment-sum off.
+    ``num_cols`` > 0 marks ``bins`` as 4-bit nibble-packed with that many
+    logical columns."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas and bins.shape[0] % 1024 == 0:
-        return histogram_pallas_masked(bins, values, num_bins, start, count)
-    return histogram_xla_masked(bins, values, num_bins, start, count)
+        return histogram_pallas_masked(bins, values, num_bins, start, count,
+                                       num_cols=num_cols)
+    return histogram_xla_masked(bins, values, num_bins, start, count,
+                                num_cols=num_cols)
 
 
 def partition_buckets(n: int, row_tile: int = 1024) -> tuple:

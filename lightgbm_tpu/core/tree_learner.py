@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .histogram import (build_histogram, build_histogram_bounded,
-                        build_histogram_masked, partition_buckets, _pad_bins)
+                        build_histogram_masked, pack_nibbles,
+                        partition_buckets, _pad_bins)
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, per_feature_best_combined,
                     reduce_feature_best, sync_best, K_MIN_SCORE)
@@ -420,7 +421,7 @@ def _ffill_nonzero(x: jax.Array) -> jax.Array:
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "params", "num_bins",
                      "use_pallas", "has_categorical", "has_monotone",
-                     "feat_num_bins"))
+                     "feat_num_bins", "packed_cols"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -430,7 +431,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            has_monotone: bool = False,
                            feat_num_bins: int = 0,
                            unpack_lanes=None,
-                           forced=None, cegb=None) -> TreeArrays:
+                           forced=None, cegb=None,
+                           packed_cols: int = 0) -> TreeArrays:
     """Leaf-wise growth with per-leaf physical row partitions.
 
     The TPU counterpart of the reference's ``DataPartition``
@@ -547,10 +549,18 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             valsw = jax.lax.dynamic_slice(valsp, (s0, 0), (R, 2))
             ordw = jax.lax.dynamic_slice(order, (s0,), (R,))
             iota = jnp.arange(R, dtype=jnp.int32)
-            colw = jnp.sum(binsw.astype(jnp.int32)
-                           * (jnp.arange(ncols, dtype=jnp.int32)
-                              == _feature_column(feat_id, feat)),
-                           axis=1)
+            gcol = _feature_column(feat_id, feat)
+            if packed_cols:
+                # 4-bit storage (dense_nbits_bin.hpp): select the byte column,
+                # then the nibble
+                byte = jnp.sum(binsw.astype(jnp.int32)
+                               * (jnp.arange(ncols, dtype=jnp.int32)
+                                  == gcol // 2), axis=1)
+                colw = (byte >> (4 * (gcol % 2))) & 15
+            else:
+                colw = jnp.sum(binsw.astype(jnp.int32)
+                               * (jnp.arange(ncols, dtype=jnp.int32)
+                                  == gcol), axis=1)
             colw = _unfold_bin(colw, feat_id, feat)
             glw = _route_left(colw, thr, default_left,
                               feat.missing_type[feat_id],
@@ -576,8 +586,9 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             left_smaller = nl * 2 <= c
             rel_s = jnp.where(left_smaller, rel_b, rel_b + nl)
             cnt_s = jnp.minimum(nl, c - nl)
-            hist_small = build_histogram_masked(binsw, valsw, num_bins, rel_s, cnt_s,
-                                                use_pallas)
+            hist_small = build_histogram_masked(binsw, valsw, num_bins,
+                                                rel_s, cnt_s, use_pallas,
+                                                num_cols=packed_cols)
             return binsp, valsp, order, hist_small, nl, left_smaller
 
         return branch
@@ -586,8 +597,9 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- root ----
     values = jnp.stack([grad, hess], axis=1)
-    hist0 = build_histogram_masked(bins, values, num_bins, jnp.int32(0), jnp.int32(n),
-                                   use_pallas)
+    hist0 = build_histogram_masked(bins, values, num_bins, jnp.int32(0),
+                                   jnp.int32(n), use_pallas,
+                                   num_cols=packed_cols)
     sum_g = jnp.sum(grad)
     sum_h = jnp.sum(hess)
     no_min = jnp.float32(-np.inf)
@@ -786,8 +798,10 @@ class SerialTreeLearner:
     """Host wrapper: owns device views + static metadata, compiles the build."""
 
     # parallel learners shard over features and take one column per feature;
-    # the serial learner consumes EFB group columns directly
+    # the serial learner consumes EFB group columns directly (and packs
+    # 4-bit bins two-per-byte when every group fits a nibble)
     supports_groups = True
+    supports_packing = True
 
     def __init__(self, dataset: BinnedDataset, config) -> None:
         self.dataset = dataset
@@ -841,8 +855,16 @@ class SerialTreeLearner:
         # rows padded so the Pallas row tile divides N
         self.num_data = dataset.num_data
         self.padded_rows = (-self.num_data) % 1024 if self.use_pallas else 0
-        self._upload_bins(dataset.binned if self.grouped or not dataset.is_bundled
-                          else dataset.unbundled_matrix())
+        matrix = (dataset.binned if self.grouped or not dataset.is_bundled
+                  else dataset.unbundled_matrix())
+        self.packed_cols = 0
+        self._route_bins_cache = None
+        if self.supports_packing and dataset.max_group_bin <= 16 \
+                and matrix.shape[1] > 1:
+            # 4-bit packing (dense_nbits_bin.hpp): two columns per byte
+            self.packed_cols = matrix.shape[1]
+            matrix = pack_nibbles(matrix)
+        self._upload_bins(matrix)
         self.forced = self._load_forced_splits(config, dataset)
         self.cegb = self._init_cegb(config, dataset)
         self.cegb_used = (jnp.zeros((dataset.num_features,), bool)
@@ -950,13 +972,25 @@ class SerialTreeLearner:
             has_monotone=self.has_monotone,
             feat_num_bins=self.feat_bins,
             unpack_lanes=self.unpack_lanes,
-            forced=self.forced, cegb=cegb)
+            forced=self.forced, cegb=cegb,
+            packed_cols=self.packed_cols)
         if self.cegb is not None:
             # persist feature-used state across trees
             # (is_feature_used_in_split_ lives for the whole training)
             valid = jnp.arange(self.num_leaves) < (arrays.num_leaves - 1)
             self.cegb_used = self.cegb_used.at[arrays.split_feature].max(valid)
         return arrays
+
+    def route_bins_matrix(self) -> jax.Array:
+        """Training bins with one column per group column (unpacked view for
+        route_binned consumers: DART drops, model replay).  Cached."""
+        if not self.packed_cols:
+            return self.bins
+        if self._route_bins_cache is None:
+            from .histogram import unpack_nibbles
+            self._route_bins_cache = unpack_nibbles(self.bins,
+                                                    self.packed_cols)
+        return self._route_bins_cache
 
     def valid_bins(self, dataset: BinnedDataset) -> np.ndarray:
         """Binned matrix of a validation set in this learner's layout."""

@@ -44,6 +44,7 @@ class _ParallelTreeLearner(SerialTreeLearner):
 
     mode = "data_rs"
     supports_groups = False  # feature sharding wants one column per feature
+    supports_packing = False
 
     def __init__(self, dataset, config, mesh: Optional[Mesh] = None) -> None:
         super().__init__(dataset, config)

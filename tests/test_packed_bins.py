@@ -1,0 +1,106 @@
+"""4-bit packed bin storage (dense_nbits_bin.hpp): when every group fits a
+nibble (max_bin <= 15), the serial learner stores two columns per byte and
+unpacks in the kernel/routing — training must match the unpacked path."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.histogram import (histogram_pallas_masked,
+                                         histogram_xla_masked, pack_nibbles,
+                                         unpack_nibbles)
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.RandomState(0)
+    for cols in (4, 7):
+        bins = rng.randint(0, 16, size=(64, cols)).astype(np.uint8)
+        packed = pack_nibbles(bins)
+        assert packed.shape == (64, (cols + 1) // 2)
+        out = np.asarray(unpack_nibbles(jnp.asarray(packed), cols))
+        np.testing.assert_array_equal(out, bins)
+
+
+def test_packed_kernel_matches_xla():
+    rng = np.random.RandomState(1)
+    n, c = 2048, 6
+    bins = rng.randint(0, 15, size=(n, c)).astype(np.uint8)
+    vals = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    packed = jnp.asarray(pack_nibbles(bins))
+    ref = histogram_xla_masked(jnp.asarray(bins), vals, 128,
+                               jnp.int32(100), jnp.int32(1500))
+    got = histogram_pallas_masked(packed, vals, 128, jnp.int32(100),
+                                  jnp.int32(1500), num_cols=c, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("objective", ["binary", "regression"])
+def test_packed_training_matches_unpacked(objective, monkeypatch):
+    from lightgbm_tpu.core.tree_learner import SerialTreeLearner
+
+    rng = np.random.RandomState(7)
+    n = 4000
+    X = rng.normal(size=(n, 7)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.4, size=n))
+    if objective == "binary":
+        y = (y > 0).astype(np.float64)
+    out = {}
+    for force_unpacked in (False, True):
+        if force_unpacked:
+            monkeypatch.setattr(SerialTreeLearner, "supports_packing", False)
+        ds = BinnedDataset.from_matrix(X, label=y, max_bin=14)
+        cfg = Config(objective=objective, num_leaves=15, num_iterations=8,
+                     learning_rate=0.2, max_bin=14)
+        b = GBDT(cfg, ds, create_objective(objective, cfg))
+        assert b.learner.packed_cols == (0 if force_unpacked else 7)
+        for _ in range(8):
+            b.train_one_iter()
+        out[force_unpacked] = (np.asarray(b.train_score[0, :n]),
+                               b.save_model_to_string())
+    assert out[False][1] == out[True][1]
+    np.testing.assert_allclose(out[False][0], out[True][0], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_packed_active_when_small_bins():
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(2000, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=14)
+    cfg = Config(objective="binary", num_leaves=7, num_iterations=2,
+                 max_bin=14)
+    b = GBDT(cfg, ds, create_objective("binary", cfg))
+    assert b.learner.packed_cols == 5
+    assert b.learner.bins.shape[1] == 3  # ceil(5/2) bytes
+    ds2 = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    cfg2 = Config(objective="binary", num_leaves=7, num_iterations=2,
+                  max_bin=63)
+    b2 = GBDT(cfg2, ds2, create_objective("binary", cfg2))
+    assert b2.learner.packed_cols == 0
+
+
+def test_dart_replay_with_packed_bins():
+    """DART's drop/replay path routes through route_bins_matrix() — with 4-bit
+    packing active the replayed train scores must still equal the tree sum."""
+    from lightgbm_tpu.boosting import create_boosting
+
+    rng = np.random.RandomState(1)
+    n = 2000
+    X = rng.normal(size=(n, 7)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=14)
+    cfg = Config(objective="binary", boosting="dart", num_leaves=15,
+                 num_iterations=8, learning_rate=0.3, max_bin=14,
+                 drop_rate=0.5)
+    b = create_boosting("dart", cfg, ds, create_objective("binary", cfg))
+    assert b.learner.packed_cols == 7
+    for _ in range(8):
+        b.train_one_iter()
+    score = np.asarray(b.train_score[0, :n])
+    pred = b.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, score, rtol=1e-4, atol=1e-4)
