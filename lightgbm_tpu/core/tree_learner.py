@@ -64,7 +64,10 @@ class Comm(NamedTuple):
       (voting_parallel_tree_learner.cpp:170-366).
     """
     axis_name: str = ""
-    mode: str = "serial"   # serial | data_psum | data_rs | feature | voting
+    # serial | data_psum | data_rs | feature | voting; "data_part" tags the
+    # partitioned data-parallel learner (build_tree_partitioned + psum), which
+    # does not go through build_tree's mode dispatch
+    mode: str = "serial"
     num_shards: int = 1
     top_k: int = 20
 
@@ -421,7 +424,7 @@ def _ffill_nonzero(x: jax.Array) -> jax.Array:
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "params", "num_bins",
                      "use_pallas", "has_categorical", "has_monotone",
-                     "feat_num_bins", "packed_cols"))
+                     "feat_num_bins", "packed_cols", "axis_name"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -432,7 +435,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            feat_num_bins: int = 0,
                            unpack_lanes=None,
                            forced=None, cegb=None,
-                           packed_cols: int = 0) -> TreeArrays:
+                           packed_cols: int = 0,
+                           axis_name: str = "") -> TreeArrays:
     """Leaf-wise growth with per-leaf physical row partitions.
 
     The TPU counterpart of the reference's ``DataPartition``
@@ -442,8 +446,13 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     window), and the smaller child's histogram streams only its own rows
     (serial_tree_learner.cpp:347-356 subtraction trick for the sibling).
     Identical split semantics to :func:`build_tree`, ~num_leaves× less
-    histogram streaming on deep trees.  Single-shard only — the parallel modes
-    use :func:`build_tree`.
+    histogram streaming on deep trees.  With ``axis_name`` set this runs under
+    ``jax.shard_map`` with rows sharded: each shard partitions its own rows
+    (windows are shard-local), child histograms are ``psum``'d into global
+    histograms — the data-parallel comm structure of
+    data_parallel_tree_learner.cpp with the partitioned builder's per-leaf
+    cost.  The histogrammed side is chosen by the replicated estimated counts
+    (serial_tree_learner.cpp:347-356), so every shard streams the same child.
 
     ``forced``: optional (leaf_ids [S], features [S], threshold_bins [S]) BFS
     schedule of forced splits (serial_tree_learner.cpp:458 ForceSplits) — the
@@ -542,7 +551,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         child; returns updated partitioned arrays + the child histogram."""
 
         def branch(binsp, valsp, order, b, c, feat_id, thr, default_left,
-                   is_cat, bitset):
+                   is_cat, bitset, left_smaller):
             s0 = jnp.clip(b, 0, n - R)
             rel_b = b - s0
             binsw = jax.lax.dynamic_slice(binsp, (s0, 0), (R, ncols))
@@ -582,14 +591,15 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             binsp = jax.lax.dynamic_update_slice(binsp, binsw, (s0, 0))
             valsp = jax.lax.dynamic_update_slice(valsp, valsw, (s0, 0))
             order = jax.lax.dynamic_update_slice(order, ordw, (s0,))
-            # smaller child's histogram from the fresh slice
-            left_smaller = nl * 2 <= c
+            # smaller child's histogram from the fresh slice; the side is
+            # chosen from replicated global estimates so every shard streams
+            # the same child (required for the psum below)
             rel_s = jnp.where(left_smaller, rel_b, rel_b + nl)
-            cnt_s = jnp.minimum(nl, c - nl)
+            cnt_s = jnp.where(left_smaller, nl, c - nl)
             hist_small = build_histogram_masked(binsw, valsw, num_bins,
                                                 rel_s, cnt_s, use_pallas,
                                                 num_cols=packed_cols)
-            return binsp, valsp, order, hist_small, nl, left_smaller
+            return binsp, valsp, order, hist_small, nl
 
         return branch
 
@@ -602,6 +612,12 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                    num_cols=packed_cols)
     sum_g = jnp.sum(grad)
     sum_h = jnp.sum(hess)
+    if axis_name:
+        # root aggregate + histogram Allreduce
+        # (data_parallel_tree_learner.cpp:99-146)
+        hist0 = jax.lax.psum(hist0, axis_name)
+        sum_g = jax.lax.psum(sum_g, axis_name)
+        sum_h = jax.lax.psum(sum_h, axis_name)
     no_min = jnp.float32(-np.inf)
     no_max = jnp.float32(np.inf)
     used0 = (cegb[2] if cegb is not None else jnp.zeros((f,), bool))
@@ -661,11 +677,17 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 b = BestSplit(*[jnp.where(fvalid, fx, x)
                                 for fx, x in zip(fbest, b)])
             wb, wc = st.begin[leaf], st.wcount[leaf]
+            left_smaller = b.left_count <= b.right_count
             which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
-            binsp, valsp, order, hist_small, nl, left_smaller = jax.lax.switch(
+            binsp, valsp, order, hist_small, nl = jax.lax.switch(
                 which, branches, st.binsp, st.valsp, st.order, wb, wc,
                 b.feature, b.threshold, b.default_left,
-                feat.is_categorical[b.feature], b.cat_bitset)
+                feat.is_categorical[b.feature], b.cat_bitset, left_smaller)
+            if axis_name:
+                # per-split histogram Allreduce of the smaller child
+                # (the reference's ReduceScatter at
+                # data_parallel_tree_learner.cpp:161, as psum)
+                hist_small = jax.lax.psum(hist_small, axis_name)
 
             hist_larger = st.hist[leaf] - hist_small
             hist_left = jnp.where(left_smaller, hist_small, hist_larger)

@@ -5,11 +5,13 @@ Network layer (src/treelearner/{data,feature,voting}_parallel_tree_learner.cpp,
 src/network/) — see lightgbm_tpu/core/tree_learner.py:Comm for the mapping.
 """
 from .learners import (DataParallelPsumTreeLearner, DataParallelTreeLearner,
-                       FeatureParallelTreeLearner, VotingParallelTreeLearner,
-                       create_tree_learner, default_mesh)
+                       FeatureParallelTreeLearner,
+                       PartitionedDataParallelTreeLearner,
+                       VotingParallelTreeLearner, create_tree_learner,
+                       default_mesh)
 
 __all__ = [
     "DataParallelPsumTreeLearner", "DataParallelTreeLearner",
-    "FeatureParallelTreeLearner", "VotingParallelTreeLearner",
-    "create_tree_learner", "default_mesh",
+    "FeatureParallelTreeLearner", "PartitionedDataParallelTreeLearner",
+    "VotingParallelTreeLearner", "create_tree_learner", "default_mesh",
 ]

@@ -28,7 +28,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.split import FeatureInfo
-from ..core.tree_learner import Comm, SerialTreeLearner, TreeArrays, build_tree
+from ..core.tree_learner import (Comm, SerialTreeLearner, TreeArrays,
+                                 build_tree, build_tree_partitioned)
 
 
 def default_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
@@ -134,6 +135,33 @@ class DataParallelTreeLearner(_ParallelTreeLearner):
     mode = "data_rs"
 
 
+class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
+    """tree_learner=data on the partitioned builder: rows sharded, per-leaf
+    physical partitions kept shard-local, child histograms psum'd over ICI —
+    the reference data-parallel comm structure
+    (data_parallel_tree_learner.cpp:149-240) at the partitioned builder's
+    per-leaf cost instead of full-data streaming per split."""
+    mode = "data_part"
+
+    def _make_build_fn(self):
+        fn = functools.partial(
+            build_tree_partitioned, num_leaves=self.num_leaves,
+            max_depth=self.max_depth, params=self.params,
+            num_bins=self.num_bins, use_pallas=self.use_pallas,
+            has_categorical=self.has_categorical,
+            has_monotone=self.has_monotone,
+            feat_num_bins=self.feat_bins, unpack_lanes=self.unpack_lanes,
+            packed_cols=self.packed_cols, axis_name=self.axis)
+        row = P(self.axis)
+        out_specs = TreeArrays(
+            *([P()] * len(TreeArrays._fields)))._replace(row_leaf=row)
+        shard_fn = jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(P(self.axis, None), row, row, P(), P(), P()),
+            out_specs=out_specs, check_vma=False)
+        return jax.jit(shard_fn)
+
+
 class DataParallelPsumTreeLearner(_ParallelTreeLearner):
     """Data parallel with full-histogram psum: every shard scans all features.
 
@@ -154,7 +182,10 @@ class VotingParallelTreeLearner(_ParallelTreeLearner):
 
 _LEARNERS = {
     "serial": SerialTreeLearner,
-    "data": DataParallelTreeLearner,
+    # the partitioned data-parallel learner has no feature-sharding
+    # constraint, so it serves tree_learner=data at any feature count; the
+    # reduce-scatter (data_rs) and psum legacy learners remain importable
+    "data": PartitionedDataParallelTreeLearner,
     "feature": FeatureParallelTreeLearner,
     "voting": VotingParallelTreeLearner,
 }
@@ -176,9 +207,4 @@ def create_tree_learner(dataset, config, mesh: Optional[Mesh] = None):
     if kind == "serial":
         return SerialTreeLearner(dataset, config)
     cls = _LEARNERS[kind]
-    if cls is DataParallelTreeLearner:
-        n_dev = (int(np.prod(mesh.devices.shape)) if mesh is not None
-                 else len(jax.devices()))
-        if dataset.num_features < n_dev:
-            cls = DataParallelPsumTreeLearner
     return cls(dataset, config, mesh=mesh)

@@ -15,6 +15,7 @@ from lightgbm_tpu.io.dataset import BinnedDataset
 from lightgbm_tpu.parallel import (DataParallelPsumTreeLearner,
                                    DataParallelTreeLearner,
                                    FeatureParallelTreeLearner,
+                                   PartitionedDataParallelTreeLearner,
                                    VotingParallelTreeLearner,
                                    create_tree_learner, default_mesh)
 
@@ -99,7 +100,7 @@ def test_factory_single_device_falls_back_to_serial(problem):
 
 def test_factory_names(problem):
     ds, _, _ = problem
-    for name, cls in [("data", DataParallelTreeLearner),
+    for name, cls in [("data", PartitionedDataParallelTreeLearner),
                       ("feature", FeatureParallelTreeLearner),
                       ("voting", VotingParallelTreeLearner)]:
         learner = create_tree_learner(ds, Config(tree_learner=name))
@@ -108,7 +109,8 @@ def test_factory_names(problem):
 
 def test_gbdt_indivisible_rows_and_few_features():
     """N % num_shards != 0 through the full GBDT loop (regression: grad was
-    double-padded), and F < num_shards auto-selects the psum variant."""
+    double-padded); F < num_shards is fine — the partitioned data-parallel
+    learner has no feature-sharding constraint."""
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.objective import create_objective
 
@@ -119,7 +121,7 @@ def test_gbdt_indivisible_rows_and_few_features():
     cfg = Config(objective="regression", tree_learner="data", num_leaves=7,
                  num_iterations=3, bagging_fraction=0.8, bagging_freq=1)
     booster = GBDT(cfg, ds, create_objective("regression", cfg))
-    assert type(booster.learner) is DataParallelPsumTreeLearner  # F=5 < 8
+    assert type(booster.learner) is PartitionedDataParallelTreeLearner
     for _ in range(3):
         booster.train_one_iter()
     assert booster.num_trees == 3
@@ -141,4 +143,5 @@ def test_gbdt_end_to_end_data_parallel(problem):
         label = np.asarray(ds.metadata.label)
         pred = np.asarray(booster.train_score[0, :ds.num_data])
         scores[lt] = float(np.mean((label - pred) ** 2))
-    assert scores["data"] == pytest.approx(scores["serial"], rel=1e-4)
+    # psum reduction order can flip exact gain ties, but quality must hold
+    assert scores["data"] == pytest.approx(scores["serial"], rel=2e-4)
