@@ -857,6 +857,25 @@ class GBDT:
         self.iter_ += other.iter_
         self._drop_rollback_caches()
 
+    def shuffle_models(self, start_iter: int = 0, end_iter: int = -1) -> None:
+        """Shuffle tree order in [start_iter, end_iter) iterations
+        (gbdt.h ShuffleModels; used when merging boosters)."""
+        models = self.models
+        K = self.num_tree_per_iteration
+        total_iter = len(models) // K
+        start_iter = max(0, start_iter)
+        # reference contract: end_iter <= 0 means the last iteration
+        end = total_iter if end_iter <= 0 else min(end_iter, total_iter)
+        if end - start_iter <= 1:
+            return
+        rng = np.random.RandomState(42)
+        order = start_iter + rng.permutation(end - start_iter)
+        chunk = [models[i * K:(i + 1) * K] for i in range(total_iter)]
+        shuffled = (chunk[:start_iter]
+                    + [chunk[i] for i in order] + chunk[end:])
+        self._models = [t for c in shuffled for t in c]
+        self._drop_rollback_caches()
+
     def set_leaf_value(self, tree_idx: int, leaf_idx: int, value: float) -> None:
         """Directly set one leaf's output (c_api.cpp LGBM_BoosterSetLeafValue)."""
         tree = self.models[tree_idx]

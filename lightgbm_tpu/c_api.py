@@ -473,7 +473,9 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
 
     @export("LGBM_DatasetAddFeaturesFrom")
     def _(target, source):
-        raise LightGBMError("DatasetAddFeaturesFrom is not supported")
+        ct = _get(_opt_handle(target))
+        cs = _get(_opt_handle(source))
+        ct.ds.construct().handle.add_features_from(cs.ds.construct().handle)
 
     # ---- booster ----
 
@@ -500,7 +502,8 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
 
     @export("LGBM_BoosterShuffleModels")
     def _(handle, start_iter, end_iter):
-        raise LightGBMError("BoosterShuffleModels is not supported")
+        cb = _get(_opt_handle(handle))
+        cb.booster._booster.shuffle_models(int(start_iter), int(end_iter))
 
     @export("LGBM_BoosterMerge")
     def _(handle, other_handle):

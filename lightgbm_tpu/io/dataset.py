@@ -417,6 +417,40 @@ class BinnedDataset:
             out.raw_data = self.raw_data[indices]
         return out
 
+    def add_features_from(self, other: "BinnedDataset") -> None:
+        """Append another dataset's features (same rows) in place
+        (dataset.cpp AddFeaturesFrom / c_api LGBM_DatasetAddFeaturesFrom).
+        Appended features keep their own bin mappers; groups become
+        singletons (no re-bundling across datasets, like the reference's
+        group-level merge)."""
+        if other.num_data != self.num_data:
+            Log.fatal("Cannot add features from a dataset with %d rows to "
+                      "one with %d rows", other.num_data, self.num_data)
+        mine = self.unbundled_matrix()
+        theirs = other.unbundled_matrix()
+        dtype = (np.uint16 if (mine.dtype == np.uint16
+                               or theirs.dtype == np.uint16) else np.uint8)
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.feature_names = list(self.feature_names) + list(other.feature_names)
+        self.num_total_features += other.num_total_features
+        self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
+                                 if not m.is_trivial]
+        self.inner_feature_map = {f: j for j, f
+                                  in enumerate(self.used_feature_idx)}
+        self.num_bin_per_feature = [self.bin_mappers[i].num_bin
+                                    for i in self.used_feature_idx]
+        merged = np.concatenate([mine.astype(dtype), theirs.astype(dtype)],
+                                axis=1)
+        self.feature_groups = [[j] for j in range(merged.shape[1])]
+        self._assign_group_layout()
+        self.binned = merged
+        if self.raw_data is not None and other.raw_data is not None:
+            self.raw_data = np.concatenate([self.raw_data, other.raw_data],
+                                           axis=1)
+        else:
+            self.raw_data = None
+        self._device_cache = None
+
     def feature_infos(self) -> List[str]:
         """Per-original-feature info strings for the model file
         (gbdt_model_text.cpp feature_infos: ``[min:max]`` or category list)."""

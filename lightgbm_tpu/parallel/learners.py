@@ -116,10 +116,12 @@ class _ParallelTreeLearner(SerialTreeLearner):
 
     def train(self, grad: jax.Array, hess: jax.Array, num_data_in_bag,
               feature_mask=None) -> TreeArrays:
-        nf_padded = self.bins.shape[1]
+        # feature count, NOT the bins width (bins may be nibble-packed)
+        nf_padded = int(self.feat.num_bin.shape[0])
         if feature_mask is None:
             fm = np.ones(nf_padded, dtype=bool)
-            fm[nf_padded - self.feature_pad:] = False
+            if self.feature_pad:
+                fm[nf_padded - self.feature_pad:] = False
         else:
             fm = np.concatenate([np.asarray(feature_mask),
                                  np.zeros(self.feature_pad, dtype=bool)])
@@ -142,6 +144,9 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
     (data_parallel_tree_learner.cpp:149-240) at the partitioned builder's
     per-leaf cost instead of full-data streaming per split."""
     mode = "data_part"
+    # no feature sharding here, so EFB group columns and 4-bit packing apply
+    supports_groups = True
+    supports_packing = True
 
     def _make_build_fn(self):
         fn = functools.partial(
@@ -163,10 +168,9 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
 
 
 class DataParallelPsumTreeLearner(_ParallelTreeLearner):
-    """Data parallel with full-histogram psum: every shard scans all features.
-
-    Picked automatically when there are fewer features than shards — there the
-    reduce-scatter layout would hand most chips only padding."""
+    """Data parallel with full-histogram psum: every shard scans all features
+    of the legacy full-stream builder (kept for comparison; tree_learner=data
+    uses the partitioned psum learner)."""
     mode = "data_psum"
 
 

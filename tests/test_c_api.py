@@ -219,3 +219,28 @@ def test_booster(lib, data_files, tmp_path):
 def test_network_shims(lib):
     check_call(lib, lib.LGBM_NetworkInit(c_str("127.0.0.1:1234"), 1234, 120, 1))
     check_call(lib, lib.LGBM_NetworkFree())
+
+
+def test_add_features_and_shuffle(lib, data_files):
+    train = load_from_mat(lib, data_files["train"], None)
+    other = load_from_mat(lib, data_files["train"], None)
+    check_call(lib, lib.LGBM_DatasetAddFeaturesFrom(train, other))
+    nf = ctypes.c_int()
+    check_call(lib, lib.LGBM_DatasetGetNumFeature(train, ctypes.byref(nf)))
+    assert nf.value == 12
+    booster = ctypes.c_void_p()
+    check_call(lib, lib.LGBM_BoosterCreate(
+        train, c_str("app=binary num_leaves=7 verbose=-1"),
+        ctypes.byref(booster)))
+    fin = ctypes.c_int(0)
+    for _ in range(6):
+        check_call(lib, lib.LGBM_BoosterUpdateOneIter(booster,
+                                                      ctypes.byref(fin)))
+    check_call(lib, lib.LGBM_BoosterShuffleModels(booster, 1, 5))
+    n_total = ctypes.c_int()
+    check_call(lib, lib.LGBM_BoosterNumberOfTotalModel(booster,
+                                                       ctypes.byref(n_total)))
+    assert n_total.value == 6
+    check_call(lib, lib.LGBM_BoosterFree(booster))
+    check_call(lib, lib.LGBM_DatasetFree(train))
+    check_call(lib, lib.LGBM_DatasetFree(other))
