@@ -156,7 +156,7 @@ def _hist_kernel_masked(win_ref, bins_ref, vals_ref, out_ref, *,
                                              "num_cols", "interpret"))
 def histogram_pallas_masked(bins: jax.Array, values: jax.Array, num_bins: int,
                             start: jax.Array, count: jax.Array,
-                            row_tile: int = 1024, num_cols: int = 0,
+                            row_tile: int = 2048, num_cols: int = 0,
                             interpret: bool = False) -> jax.Array:
     """Histogram over rows [start, start+count) of a (bucket-sized) slice.
 
@@ -222,14 +222,14 @@ def build_histogram_masked(bins: jax.Array, values: jax.Array, num_bins: int,
     logical columns."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
-    if use_pallas and bins.shape[0] % 1024 == 0:
+    if use_pallas and bins.shape[0] % 2048 == 0:
         return histogram_pallas_masked(bins, values, num_bins, start, count,
                                        num_cols=num_cols)
     return histogram_xla_masked(bins, values, num_bins, start, count,
                                 num_cols=num_cols)
 
 
-def partition_buckets(n: int, row_tile: int = 1024) -> tuple:
+def partition_buckets(n: int, row_tile: int = 2048) -> tuple:
     """Static window-slice sizes (rows): powers of 4 × row_tile, plus n."""
     sizes = []
     b = row_tile

@@ -71,7 +71,7 @@ class _ParallelTreeLearner(SerialTreeLearner):
     def _repad(self, dataset) -> None:
         d = self.num_shards
         if self.mode != "feature":
-            row_mult = 1024 * d if self.use_pallas else d
+            row_mult = 2048 * d if self.use_pallas else d
             self.padded_rows = (-self.num_data) % row_mult
         binned = self._pad_host_rows(self._host_bins)
         del self._host_bins
