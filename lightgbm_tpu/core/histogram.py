@@ -6,17 +6,31 @@ family (src/io/dense_bin.hpp:48, src/io/dataset.cpp:1265,1370) and the OpenCL
 
 TPU-first design: TPUs have no fast scatter-add, so instead of per-workgroup local
 histograms with float atomics (histogram256.cl:100-130) the histogram is computed as
-a one-hot contraction per feature tile — compare a bin tile against an iota to get a
-``[rows, bins]`` one-hot and contract it with the (grad, hess) pair on the MXU/VPU.
-Accumulation order is fixed by the sequential TPU grid, so results are deterministic
-(unlike the reference GPU path's atomic adds).
+a one-hot contraction on the MXU.  Filling the systolic array is everything:
+
+- The left operand carries FOUR rows — (grad_hi, hess_hi, grad_lo, hess_lo) — a
+  bf16 hi/lo split of the f32 values.  bf16 one-hot entries are exact, products
+  accumulate in f32, and hi + lo recovers ~f32 precision (relative error ~2^-16),
+  all in a SINGLE MXU pass instead of the 6-pass f32 emulation.
+- The right operand packs ``128 // num_bins`` features per 128-lane output tile
+  (their one-hots OR'd into disjoint lane ranges), so a 64-bin dataset computes
+  two features per contraction and a 4-bit-packed (16→32-bin) dataset four —
+  the lane dimension is fully used instead of 2/128.  The same role the
+  reference's GPU learner plays with its 4-features-per-DWORD packing
+  (gpu_tree_learner.cpp:317-344).
+
+Accumulation order is fixed by the sequential TPU grid, so results are
+deterministic (unlike the reference GPU path's atomic adds).
 
 Two channels per bin — (sum_grad, sum_hess) — matching the reference's 16-byte
 histogram entry (bin.h:41 ``HistogramSumReducer``); bin counts are derived from
 hessians downstream exactly like feature_histogram.hpp:535 ``cnt_factor``.
 
-Leaf membership / bagging are handled by pre-masking grad/hess to zero, so the
-kernel itself is mask-free and shape-static.
+Per-leaf windows ride scalar prefetch: the window (start, count) is prefetched
+into SMEM and drives the input index_map, so row tiles fully outside the leaf's
+window skip both the HBM fetch and the compute — cost scales with the leaf's
+row count, not the slice size (the reference's ordered-index histograms,
+dense_bin.hpp:48 ConstructHistogram over ``data_indices`` begin..end).
 """
 from __future__ import annotations
 
@@ -31,66 +45,162 @@ _LANE = 128
 
 
 def _pad_bins(num_bins: int) -> int:
+    """Lane-padded width for per-feature threshold scans (VPU)."""
     return max(_LANE, -(-num_bins // _LANE) * _LANE)
+
+
+def _pad_bins_pow2(num_bins: int) -> int:
+    """Histogram-kernel bin width: next power of two, min 32 (so bitset words
+    and feature packing stay well-formed).  Small widths let several features
+    share one 128-lane MXU output tile."""
+    b = 32
+    while b < num_bins:
+        b *= 2
+    return b
 
 
 def histogram_xla(bins: jax.Array, values: jax.Array, num_bins: int) -> jax.Array:
     """Reference implementation via segment-sum; runs on any backend.
 
-    bins: [N, F] integer; values: [N, 2] f32 (grad, hess; pre-masked).
+    bins: [N, F] integer; values: [2, N] f32 (grad, hess; pre-masked,
+    channel-major so lanes run along rows on TPU).
     Returns [F, 2, num_bins] f32.
     """
     n, f = bins.shape
     ids = bins.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
-    vals = jnp.broadcast_to(values[:, None, :], (n, f, 2)).reshape(n * f, 2)
+    vals = jnp.broadcast_to(values.T[:, None, :], (n, f, 2)).reshape(n * f, 2)
     hist = jax.ops.segment_sum(vals, ids.reshape(-1), num_segments=f * num_bins)
     return hist.reshape(f, num_bins, 2).transpose(0, 2, 1)
 
 
-def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_features: int, num_bins: int):
-    @pl.when(pl.program_id(0) == 0)
+def _features_per_tile(num_bins: int) -> int:
+    return max(1, _LANE // num_bins)
+
+
+def _padded_features(num_features: int, num_bins: int) -> int:
+    fp = _features_per_tile(num_bins)
+    return -(-num_features // fp) * fp
+
+
+def _hist_kernel_mxu(win_ref, bins_ref, vals_ref, out_ref, *,
+                     num_features: int, num_bins: int, row_tile: int,
+                     packed: bool):
+    """One row tile's contribution to the histogram of rows in
+    [win[0], win[0]+win[1]).  out_ref: [4, F_pad * num_bins] f32 — rows are
+    (grad_hi, hess_hi, grad_lo, hess_lo); the caller folds hi+lo."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    bins = bins_ref[...].astype(jnp.int32)          # [Nt, F]
-    vals = vals_ref[...]                            # [Nt, 2]
-    iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
+    start, count = win_ref[0], win_ref[1]
+    base = i * row_tile
 
-    # static unroll over features (Mosaic TC has no dynamic_slice); each step is
-    # a [2, Nt] x [Nt, B] one-hot contraction on the MXU
-    for f in range(num_features):
-        col = bins[:, f:f + 1]                                      # [Nt, 1]
-        onehot = (col == iota).astype(jnp.float32)                  # [Nt, B]
-        acc = jax.lax.dot_general(vals, onehot, (((0,), (0,)), ((), ())),
-                                  precision=jax.lax.Precision.HIGHEST,
-                                  preferred_element_type=jnp.float32)  # [2, B]
-        out_ref[f, :, :] += acc
+    @pl.when((base < start + count) & (base + row_tile > start))
+    def _accum():
+        rows = base + jax.lax.broadcasted_iota(jnp.int32, (1, row_tile), 1)
+        in_w = ((rows >= start) & (rows < start + count)).astype(jnp.float32)
+        vals = vals_ref[...] * in_w                      # [2, Nt] f32
+        hi = vals.astype(jnp.bfloat16)
+        lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        v4 = jnp.concatenate([hi, lo], axis=0)           # [4, Nt] bf16
+        bins = bins_ref[...].astype(jnp.int32)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1)
+
+        def col(f):
+            if packed:
+                return (bins[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
+            return bins[:, f:f + 1]
+
+        B = num_bins
+        fp = _features_per_tile(B)
+        tpf = max(1, B // _LANE)             # lane tiles per feature (B > 128)
+        num_tiles = out_ref.shape[1] // _LANE
+        for t in range(num_tiles):
+            if B >= _LANE:
+                oh = (col(t // tpf) - (t % tpf) * _LANE) == iota
+            else:
+                oh = None
+                for j in range(fp):
+                    f = t * fp + j
+                    if f >= num_features:
+                        break
+                    m = (col(f) + j * B) == iota
+                    oh = m if oh is None else oh | m
+            acc = jax.lax.dot_general(
+                v4, oh.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [4, 128]
+            out_ref[:, t * _LANE:(t + 1) * _LANE] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
+                                             "num_cols", "interpret"))
+def histogram_pallas_masked(bins: jax.Array, values: jax.Array, num_bins: int,
+                            start: jax.Array, count: jax.Array,
+                            row_tile: int = 2048, num_cols: int = 0,
+                            interpret: bool = False) -> jax.Array:
+    """Histogram over rows [start, start+count) of a (bucket-sized) slice.
+
+    bins: [R, F] int (or [R, ceil(F/2)] nibble-packed when ``num_cols`` = F);
+    values: [2, R] f32 channel-major (NOT pre-masked); start/count: i32
+    scalars relative to the slice.  R must be a multiple of row_tile.
+    Returns [F, 2, num_bins]."""
+    n, width = bins.shape
+    f = num_cols or width
+    assert n % row_tile == 0, "pad rows to a multiple of row_tile"
+    assert _LANE % num_bins == 0 or num_bins % _LANE == 0, (
+        "num_bins must divide or be a multiple of 128 (use _pad_bins_pow2); "
+        "got %d" % num_bins)
+    f_pad = _padded_features(f, num_bins)
+    lanes = f_pad * num_bins
+    win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
+    kernel = functools.partial(_hist_kernel_mxu, num_features=f,
+                               num_bins=num_bins, row_tile=row_tile,
+                               packed=bool(num_cols))
+
+    def _in_idx(i, win_ref):
+        # tiles outside the window revisit block 0: Mosaic elides the re-fetch
+        active = ((i * row_tile < win_ref[0] + win_ref[1])
+                  & ((i + 1) * row_tile > win_ref[0]))
+        return (jnp.where(active, i, 0), 0)
+
+    def _vals_idx(i, win_ref):
+        active = ((i * row_tile < win_ref[0] + win_ref[1])
+                  & ((i + 1) * row_tile > win_ref[0]))
+        return (0, jnp.where(active, i, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // row_tile,),
+        in_specs=[
+            pl.BlockSpec((row_tile, width), _in_idx),
+            pl.BlockSpec((2, row_tile), _vals_idx),
+        ],
+        out_specs=pl.BlockSpec((4, lanes), lambda i, w: (0, 0)),
+    )
+    raw = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((4, lanes), jnp.float32),
+        interpret=interpret,
+    )(win, bins, values)
+    folded = raw[0:2] + raw[2:4]
+    return folded.reshape(2, f_pad, num_bins).transpose(1, 0, 2)[:f]
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile", "interpret"))
 def histogram_pallas(bins: jax.Array, values: jax.Array, num_bins: int,
                      row_tile: int = 2048, interpret: bool = False) -> jax.Array:
-    """Pallas TPU histogram: grid over row tiles, one-hot contraction per feature.
+    """Pallas TPU histogram over ALL rows (values pre-masked).
 
-    bins: [N, F] int (any small int dtype); values: [N, 2] f32.
+    bins: [N, F] int (any small int dtype); values: [2, N] f32 channel-major.
     Returns [F, 2, num_bins] f32.  N must be a multiple of row_tile (pad with
-    zero-valued rows).
-    """
-    n, f = bins.shape
-    assert n % row_tile == 0, "pad rows to a multiple of row_tile"
-    grid = (n // row_tile,)
-    kernel = functools.partial(_hist_kernel, num_features=f, num_bins=num_bins)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((row_tile, f), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 2), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((f, 2, num_bins), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f, 2, num_bins), jnp.float32),
-        interpret=interpret,
-    )(bins.astype(jnp.int32), values)
+    zero-valued rows)."""
+    n = bins.shape[0]
+    return histogram_pallas_masked(bins, values, num_bins, jnp.int32(0),
+                                   jnp.int32(n), row_tile=row_tile,
+                                   interpret=interpret)
 
 
 def _pick_tile(n: int) -> int | None:
@@ -110,78 +220,6 @@ def build_histogram(bins: jax.Array, values: jax.Array, num_bins: int,
         if tile is not None:
             return histogram_pallas(bins, values, num_bins, row_tile=tile)
     return histogram_xla(bins, values, num_bins)
-
-
-def _hist_kernel_masked(win_ref, bins_ref, vals_ref, out_ref, *,
-                        num_features: int, num_bins: int, row_tile: int,
-                        packed: bool):
-    """Histogram of the rows in [win[0], win[0]+win[1]) of its input slice.
-
-    The TPU analogue of the reference's per-leaf ordered-index histogram
-    (dense_bin.hpp:48 ConstructHistogram over ``data_indices`` begin..end):
-    the caller slices a bucket-sized window of the leaf-partitioned matrix,
-    this kernel masks boundary-tile rows outside the leaf's exact window, and
-    tiles fully outside skip compute — cost scales with the leaf's row count,
-    not the dataset size.  ``packed`` reads 4-bit nibble pairs
-    (dense_nbits_bin.hpp storage: two <=16-bin columns per byte)."""
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    start, count = win_ref[0], win_ref[1]
-    base = i * row_tile
-
-    @pl.when((base < start + count) & (base + row_tile > start))
-    def _accum():
-        rows = base + jax.lax.broadcasted_iota(jnp.int32, (row_tile, 1), 0)
-        in_w = ((rows >= start) & (rows < start + count)).astype(jnp.float32)
-        bins = bins_ref[...].astype(jnp.int32)
-        vals = vals_ref[...] * in_w
-        iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
-        for f in range(num_features):
-            if packed:
-                col = (bins[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
-            else:
-                col = bins[:, f:f + 1]
-            onehot = (col == iota).astype(jnp.float32)
-            acc = jax.lax.dot_general(vals, onehot, (((0,), (0,)), ((), ())),
-                                      precision=jax.lax.Precision.HIGHEST,
-                                      preferred_element_type=jnp.float32)
-            out_ref[f, :, :] += acc
-
-
-@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
-                                             "num_cols", "interpret"))
-def histogram_pallas_masked(bins: jax.Array, values: jax.Array, num_bins: int,
-                            start: jax.Array, count: jax.Array,
-                            row_tile: int = 2048, num_cols: int = 0,
-                            interpret: bool = False) -> jax.Array:
-    """Histogram over rows [start, start+count) of a (bucket-sized) slice.
-
-    bins: [R, F] int (or [R, ceil(F/2)] nibble-packed when ``num_cols`` = F);
-    values: [R, 2] f32 (NOT pre-masked); start/count: i32 scalars relative to
-    the slice.  R must be a multiple of row_tile."""
-    n, width = bins.shape
-    f = num_cols or width
-    assert n % row_tile == 0, "pad rows to a multiple of row_tile"
-    win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
-    kernel = functools.partial(_hist_kernel_masked, num_features=f,
-                               num_bins=num_bins, row_tile=row_tile,
-                               packed=bool(num_cols))
-    return pl.pallas_call(
-        kernel,
-        grid=(n // row_tile,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((row_tile, width), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, 2), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((f, 2, num_bins), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f, 2, num_bins), jnp.float32),
-        interpret=interpret,
-    )(win, bins, values)
 
 
 def unpack_nibbles(packed: jax.Array, num_cols: int) -> jax.Array:
@@ -210,7 +248,7 @@ def histogram_xla_masked(bins: jax.Array, values: jax.Array, num_bins: int,
         bins = unpack_nibbles(bins, num_cols)
     pos = jnp.arange(bins.shape[0], dtype=jnp.int32)
     in_w = ((pos >= start) & (pos < start + count)).astype(values.dtype)
-    return histogram_xla(bins, values * in_w[:, None], num_bins)
+    return histogram_xla(bins, values * in_w[None, :], num_bins)
 
 
 def build_histogram_masked(bins: jax.Array, values: jax.Array, num_bins: int,
@@ -238,74 +276,3 @@ def partition_buckets(n: int, row_tile: int = 2048) -> tuple:
         b *= 4
     sizes.append(n)
     return tuple(sizes)
-
-
-def _hist_kernel_bounded(cnt_ref, bins_ref, vals_ref, out_ref, *,
-                         num_features: int, num_bins: int, row_tile: int):
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    # tiles beyond the active row count skip both compute and (via the
-    # cnt-dependent index_map) the HBM fetch — cost scales with cnt, not N
-    @pl.when(pl.program_id(0) * row_tile < cnt_ref[0])
-    def _accum():
-        bins = bins_ref[...].astype(jnp.int32)
-        vals = vals_ref[...]
-        iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
-        for f in range(num_features):
-            onehot = (bins[:, f:f + 1] == iota).astype(jnp.float32)
-            acc = jax.lax.dot_general(vals, onehot, (((0,), (0,)), ((), ())),
-                                      precision=jax.lax.Precision.HIGHEST,
-                                      preferred_element_type=jnp.float32)
-            out_ref[f, :, :] += acc
-
-
-@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile"))
-def histogram_pallas_bounded(bins: jax.Array, values: jax.Array, num_bins: int,
-                             cnt: jax.Array, row_tile: int = 4096) -> jax.Array:
-    """Histogram over the first ``cnt`` rows of a compacted matrix.
-
-    The counterpart of the reference's per-leaf ``data_indices`` histograms
-    (dense_bin.hpp:48 ConstructHistogram over ordered indices): rows of one leaf
-    are gathered to the front, ``cnt`` rides scalar prefetch, and tiles past the
-    count are skipped.  values beyond cnt MUST already be zeroed (safety net for
-    the partial tile)."""
-    n, f = bins.shape
-    assert n % row_tile == 0, "pad rows to a multiple of row_tile"
-    grid = (n // row_tile,)
-
-    def _in_idx(i, cnt_ref):
-        # revisit block 0 for skipped tiles: Mosaic elides the re-fetch
-        return (jnp.where(i * row_tile < cnt_ref[0], i, 0), 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((row_tile, f), _in_idx),
-            pl.BlockSpec((row_tile, 2), _in_idx),
-        ],
-        out_specs=pl.BlockSpec((f, 2, num_bins), lambda i, cnt_ref: (0, 0, 0)),
-    )
-    kernel = functools.partial(_hist_kernel_bounded, num_features=f,
-                               num_bins=num_bins, row_tile=row_tile)
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((f, 2, num_bins), jnp.float32),
-    )(cnt.reshape(1).astype(jnp.int32), bins.astype(jnp.int32), values)
-
-
-def build_histogram_bounded(bins: jax.Array, values: jax.Array, num_bins: int,
-                            cnt: jax.Array,
-                            use_pallas: bool | None = None) -> jax.Array:
-    """Bounded-row histogram dispatch; values past cnt must be zero."""
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
-    if use_pallas:
-        tile = _pick_tile(bins.shape[0])
-        if tile is not None:
-            return histogram_pallas_bounded(bins, values, num_bins, cnt,
-                                            row_tile=tile)
-    return histogram_xla(bins, values, num_bins)

@@ -31,9 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import (build_histogram, build_histogram_bounded,
-                        build_histogram_masked, pack_nibbles,
-                        partition_buckets, _pad_bins)
+from .histogram import (build_histogram, build_histogram_masked, pack_nibbles,
+                        partition_buckets, _pad_bins, _pad_bins_pow2)
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, per_feature_best_combined,
                     reduce_feature_best, sync_best, K_MIN_SCORE)
@@ -196,7 +195,7 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return h  # serial, feature, voting (kept local)
 
     def make_hist(vals):
-        """Stored-histogram block for this shard from masked [N,2] values."""
+        """Stored-histogram block for this shard from masked [2,N] values."""
         if mode == "feature":
             bc = jax.lax.dynamic_slice_in_dim(bins, off, chunk, axis=1)
             return build_histogram(bc, vals, B, use_pallas)
@@ -211,8 +210,8 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         rows through the one-hot-matmul kernel with zeroed values.  The win to
         chase instead is windowed periodic repartition (sort rows by leaf once
         per level, then the bounded kernel skips tiles outside the leaf's
-        window — see histogram_pallas_bounded)."""
-        return make_hist(values * mask_b.astype(f32)[:, None])
+        window — see build_tree_partitioned)."""
+        return make_hist(values * mask_b.astype(f32)[None, :])
 
     def pfb(h_, feat_, mask_, sg, sh, cnt, params_, cmn, cmx):
         return per_feature_best_combined(
@@ -250,7 +249,7 @@ def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                  cmn, cmx)
         return reduce_feature_best(fb, elected)
 
-    values = jnp.stack([grad, hess], axis=1)
+    values = jnp.stack([grad, hess], axis=0)
     hist0 = make_hist(values)
     sum_g = jnp.sum(grad)
     sum_h = jnp.sum(hess)
@@ -400,9 +399,15 @@ class _PState(NamedTuple):
     cmax: jax.Array             # [L] upper bounds
     begin: jax.Array            # [L] i32 window start (physical, partitioned)
     wcount: jax.Array           # [L] i32 window length (physical rows)
-    binsp: jax.Array            # [N, F] bins, leaf-partitioned
-    valsp: jax.Array            # [N, 2] (grad, hess), leaf-partitioned
-    order: jax.Array            # [N] i32: position -> original row
+    order: jax.Array            # [N] i32: position -> original row; the ONLY
+                                # partition state.  bins/values stay read-only
+                                # (loop-invariant) and windows gather their
+                                # rows per split — the reference GPU learner's
+                                # ordered-indices pattern
+                                # (gpu_tree_learner.cpp:818-867); rewriting
+                                # partitioned copies in the loop carry cost an
+                                # XLA buffer copy of the full matrices every
+                                # split
     lsum_g: jax.Array           # [L] leaf gradient totals (forced splits)
     lsum_h: jax.Array           # [L] leaf hessian totals
     feat_used: jax.Array        # [F] bool: feature split somewhere (CEGB)
@@ -547,16 +552,21 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     vmapped_best = jax.vmap(best_of, in_axes=(0, 0, 0, 0, 0, 0, None))
 
     def make_branch(R):
-        """Partition the parent window (size <= R) and histogram the smaller
-        child; returns updated partitioned arrays + the child histogram."""
+        """Partition the parent window (size <= R) of the row order and
+        histogram the smaller child; bins/values are read-only closures.
 
-        def branch(binsp, valsp, order, b, c, feat_id, thr, default_left,
+        The rows of the window are gathered by their order indices (the
+        reference GPU learner's ordered grad/hess copies,
+        gpu_tree_learner.cpp:818-867), routed, and the stable partition is
+        applied to the ORDER only; the child histogram streams the freshly
+        gathered leaf-contiguous rows with tiles outside its window skipped."""
+
+        def branch(order, b, c, feat_id, thr, default_left,
                    is_cat, bitset, left_smaller):
             s0 = jnp.clip(b, 0, n - R)
             rel_b = b - s0
-            binsw = jax.lax.dynamic_slice(binsp, (s0, 0), (R, ncols))
-            valsw = jax.lax.dynamic_slice(valsp, (s0, 0), (R, 2))
             ordw = jax.lax.dynamic_slice(order, (s0,), (R,))
+            binsw = jnp.take(bins, ordw, axis=0, unique_indices=True)
             iota = jnp.arange(R, dtype=jnp.int32)
             gcol = _feature_column(feat_id, feat)
             if packed_cols:
@@ -585,28 +595,26 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                              jnp.where(inw, rel_b + nl + cr - 1, iota))
             src = jnp.zeros((R,), jnp.int32).at[dest].set(
                 iota, unique_indices=True)
-            binsw = jnp.take(binsw, src, axis=0, unique_indices=True)
-            valsw = jnp.take(valsw, src, axis=0, unique_indices=True)
             ordw = jnp.take(ordw, src, unique_indices=True)
-            binsp = jax.lax.dynamic_update_slice(binsp, binsw, (s0, 0))
-            valsp = jax.lax.dynamic_update_slice(valsp, valsw, (s0, 0))
             order = jax.lax.dynamic_update_slice(order, ordw, (s0,))
-            # smaller child's histogram from the fresh slice; the side is
+            # smaller child's histogram from the permuted window; the side is
             # chosen from replicated global estimates so every shard streams
             # the same child (required for the psum below)
             rel_s = jnp.where(left_smaller, rel_b, rel_b + nl)
             cnt_s = jnp.where(left_smaller, nl, c - nl)
-            hist_small = build_histogram_masked(binsw, valsw, num_bins,
+            binsc = jnp.take(binsw, src, axis=0, unique_indices=True)
+            valsc = jnp.take(values, ordw, axis=1, unique_indices=True)
+            hist_small = build_histogram_masked(binsc, valsc, num_bins,
                                                 rel_s, cnt_s, use_pallas,
                                                 num_cols=packed_cols)
-            return binsp, valsp, order, hist_small, nl
+            return order, hist_small, nl
 
         return branch
 
     branches = [make_branch(R) for R in buckets]
 
     # ---- root ----
-    values = jnp.stack([grad, hess], axis=1)
+    values = jnp.stack([grad, hess], axis=0)
     hist0 = build_histogram_masked(bins, values, num_bins, jnp.int32(0),
                                    jnp.int32(n), use_pallas,
                                    num_cols=packed_cols)
@@ -645,7 +653,6 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     cmax=jnp.full((L,), np.inf, dtype=f32),
                     begin=zl(jnp.int32),
                     wcount=zl(jnp.int32).at[0].set(n),
-                    binsp=bins, valsp=values,
                     order=jnp.arange(n, dtype=jnp.int32),
                     lsum_g=zl().at[0].set(sum_g),
                     lsum_h=zl().at[0].set(sum_h),
@@ -679,8 +686,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             wb, wc = st.begin[leaf], st.wcount[leaf]
             left_smaller = b.left_count <= b.right_count
             which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
-            binsp, valsp, order, hist_small, nl = jax.lax.switch(
-                which, branches, st.binsp, st.valsp, st.order, wb, wc,
+            order, hist_small, nl = jax.lax.switch(
+                which, branches, st.order, wb, wc,
                 b.feature, b.threshold, b.default_left,
                 feat.is_categorical[b.feature], b.cat_bitset, left_smaller)
             if axis_name:
@@ -767,7 +774,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             return _PState(tree=tree_new, hist=hist_new, bests=bests,
                            cont=st.cont, cmin=cmin_new, cmax=cmax_new,
                            begin=begin, wcount=wcount,
-                           binsp=binsp, valsp=valsp, order=order,
+                           order=order,
                            lsum_g=lsum_g, lsum_h=lsum_h, feat_used=feat_used,
                            force_on=st.force_on)
 
@@ -852,9 +859,12 @@ class SerialTreeLearner:
         self.has_monotone = bool((mono != 0).any())
         self.use_pallas = jax.default_backend() == "tpu"
         self.grouped = bool(dataset.is_bundled and self.supports_groups)
+        # histogram (kernel) width is the MXU-friendly power of two; the
+        # per-feature scan width stays lane-padded only when group columns
+        # must be unpacked into per-feature lanes
         self.feat_bins = _pad_bins(dataset.max_num_bin)
         if self.grouped:
-            self.num_bins = _pad_bins(dataset.max_group_bin)
+            self.num_bins = _pad_bins_pow2(dataset.max_group_bin)
             group = jnp.asarray(dataset.group_idx)
             offset = jnp.asarray(dataset.bin_offset)
             nb = np.asarray(dataset.num_bin_per_feature)
@@ -864,7 +874,8 @@ class SerialTreeLearner:
             lmask = ((lanes >= 1) & (lanes < nb[:, None])).astype(np.float32)
             self.unpack_lanes = (jnp.asarray(lidx), jnp.asarray(lmask))
         else:
-            self.num_bins = self.feat_bins
+            self.num_bins = _pad_bins_pow2(dataset.max_num_bin)
+            self.feat_bins = self.num_bins   # scans run on the kernel block
             group = offset = None
             self.unpack_lanes = None
         self.feat = FeatureInfo(

@@ -15,7 +15,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .dataset import BinnedDataset
-from .parser import parse_file
+from .parser import detect_format, parse_file
 from ..utils.log import Log
 
 
@@ -149,40 +149,64 @@ class DatasetLoader:
         # the reference parser renumbers columns after erasing the label
         # (dataset_loader.cpp:31-130 SetHeader builds name2idx after the erase;
         # parser.hpp applies offset -1 past the label).
-        feats, label, names = parse_file(filename, header=header, label_idx=-1)
-        label_idx = _parse_column_spec(str(cfg.label_column) or "0", names,
-                                       "label")
-        if label_idx < 0:
-            label_idx = 0
-        names_nolabel = (None if names is None else
-                         names[:label_idx] + names[label_idx + 1:])
+        is_libsvm = detect_format(filename)[0] == "libsvm"
+        if is_libsvm:
+            # LibSVM's leading target IS the label; there are no positional
+            # weight/group/ignore columns to resolve (parser.hpp LibSVM branch)
+            for spec, nm in ((cfg.weight_column, "weight_column"),
+                             (cfg.group_column, "group_column"),
+                             (cfg.ignore_column, "ignore_column")):
+                if str(spec or ""):
+                    Log.warning("%s is not supported for LibSVM files and "
+                                "will be ignored (use the .weight/.query "
+                                "side files)", nm)
+            mat, label, names = parse_file(filename, header=header,
+                                           label_idx=0)
+            weight = group_col = None
+            names_nolabel = None
+            keep = list(range(mat.shape[1]))
+            feat_names = None
 
-        def to_full(idx: int) -> int:
-            """label-excluded column index -> full-file column index."""
-            return idx if idx < label_idx else idx + 1
+            def to_full(idx: int) -> int:
+                return idx
+        else:
+            feats, label, names = parse_file(filename, header=header,
+                                             label_idx=-1)
+            label_idx = _parse_column_spec(str(cfg.label_column) or "0", names,
+                                           "label")
+            if label_idx < 0:
+                label_idx = 0
+            names_nolabel = (None if names is None else
+                             names[:label_idx] + names[label_idx + 1:])
 
-        weight_idx = _parse_column_spec(str(cfg.weight_column), names_nolabel,
-                                        "weight")
-        group_idx = _parse_column_spec(str(cfg.group_column), names_nolabel,
-                                       "group")
-        if weight_idx >= 0:
-            weight_idx = to_full(weight_idx)
-        if group_idx >= 0:
-            group_idx = to_full(group_idx)
-        ignore = {to_full(i) for i in
-                  _parse_multi_column_spec(cfg.ignore_column, names_nolabel)}
+            def to_full(idx: int) -> int:
+                """label-excluded column index -> full-file column index."""
+                return idx if idx < label_idx else idx + 1
 
-        label = feats[:, label_idx]
-        weight = feats[:, weight_idx] if weight_idx >= 0 else None
-        group_col = feats[:, group_idx] if group_idx >= 0 else None
-        drop = {label_idx} | ignore
-        if weight_idx >= 0:
-            drop.add(weight_idx)
-        if group_idx >= 0:
-            drop.add(group_idx)
-        keep = [i for i in range(feats.shape[1]) if i not in drop]
-        mat = feats[:, keep]
-        feat_names = ([names[i] for i in keep] if names is not None else None)
+            weight_idx = _parse_column_spec(str(cfg.weight_column),
+                                            names_nolabel, "weight")
+            group_idx = _parse_column_spec(str(cfg.group_column),
+                                           names_nolabel, "group")
+            if weight_idx >= 0:
+                weight_idx = to_full(weight_idx)
+            if group_idx >= 0:
+                group_idx = to_full(group_idx)
+            ignore = {to_full(i) for i in
+                      _parse_multi_column_spec(cfg.ignore_column,
+                                               names_nolabel)}
+
+            label = feats[:, label_idx]
+            weight = feats[:, weight_idx] if weight_idx >= 0 else None
+            group_col = feats[:, group_idx] if group_idx >= 0 else None
+            drop = {label_idx} | ignore
+            if weight_idx >= 0:
+                drop.add(weight_idx)
+            if group_idx >= 0:
+                drop.add(group_idx)
+            keep = [i for i in range(feats.shape[1]) if i not in drop]
+            mat = feats[:, keep]
+            feat_names = ([names[i] for i in keep]
+                          if names is not None else None)
 
         # distributed loading: contiguous stripe per rank
         # (dataset_loader.cpp:168 pre_partition / sampled partitioning)

@@ -3,7 +3,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from .binary import weighted_auc
 from .metric import Metric
 
 
@@ -58,11 +57,17 @@ class MultiErrorMetric(_MulticlassMetric):
 
 class AucMuMetric(Metric):
     """AUC-mu: average pairwise class separability
-    (multiclass extension of AUC; src/metric/multiclass_metric.hpp AucMuMetric).
+    (multiclass extension of AUC; src/metric/multiclass_metric.hpp AucMuMetric,
+    Kleiman & Page ICML'19).
 
-    The reference ranks class-i-vs-class-j samples by the weighted score
-    difference a^T(p_i - p_j); with default (all-ones off-diagonal) weights this
-    reduces to ranking by score_i - score_j, which is what we compute."""
+    For each class pair (i, j) samples are ranked by their distance from the
+    separating hyperplane ``t1 * v . score`` with ``v = W[i] - W[j]`` and
+    ``t1 = v[i] - v[j]``, where W is the ``auc_mu_weights`` partition-loss
+    matrix (config.cpp:156-183 GetAucMuWeights; default all-ones off the
+    diagonal, for which the ranking reduces to score_i - score_j).  Ties
+    contribute half, mirroring the reference's sorted sweep
+    (multiclass_metric.hpp:246-280).  Like the reference, sample weights are
+    NOT consulted — its Eval counts rows only."""
     factor_to_bigger_better = 1.0
 
     def init(self, metadata, num_data):
@@ -70,19 +75,56 @@ class AucMuMetric(Metric):
         self.names = ["auc_mu"]
         self.num_class = int(self.config.num_class)
         self.label_int = self.label.astype(np.int64)
+        k = self.num_class
+        weights = list(getattr(self.config, "auc_mu_weights", []) or [])
+        if weights:
+            if len(weights) != k * k:
+                from ..utils.log import Log
+                Log.fatal("auc_mu_weights must have %d elements, but found %d",
+                          k * k, len(weights))
+            self.class_weights = np.asarray(weights, dtype=np.float64
+                                            ).reshape(k, k)
+            np.fill_diagonal(self.class_weights, 0.0)
+        else:
+            self.class_weights = 1.0 - np.eye(k)
+
+    @staticmethod
+    def _pair_auc(dist, is_i):
+        """S[i][j]/(n_i*n_j): fraction of (i, j) pairs ranked correctly, ties
+        half (the reference's sorted sweep, multiclass_metric.hpp:258-280)."""
+        order = np.argsort(dist, kind="mergesort")
+        d_sorted = dist[order]
+        i_sorted = is_i[order]
+        # per distance-tie group: j's strictly below contribute 1, j's at the
+        # same distance one half (the reference adds num_j when untied and
+        # num_j - 0.5*num_current_j when tied with the current j run)
+        _, inv = np.unique(d_sorted, return_inverse=True)
+        j_cum = np.concatenate([[0], np.cumsum(~i_sorted)])
+        group_start = np.concatenate([[0], np.flatnonzero(np.diff(inv)) + 1])
+        j_before_group = j_cum[group_start][inv]
+        j_in_group = np.bincount(inv, weights=(~i_sorted).astype(np.float64))[inv]
+        s = j_before_group + 0.5 * j_in_group
+        total = float(np.sum(s[i_sorted]))
+        n_i = float(np.sum(is_i))
+        n_j = float(np.sum(~is_i))
+        if n_i == 0 or n_j == 0:
+            return 1.0  # no rankable pairs; same credit as both-absent
+        return total / (n_i * n_j)
 
     def eval(self, score, objective=None):
         s = np.asarray(score, dtype=np.float64).reshape(self.num_class, -1)
         k = self.num_class
-        aucs = []
+        w = self.class_weights
+        total = 0.0
         for i in range(k):
             for j in range(i + 1, k):
                 sel = (self.label_int == i) | (self.label_int == j)
                 if not sel.any():
-                    aucs.append(1.0)
+                    total += 1.0
                     continue
-                y = (self.label_int[sel] == i).astype(np.float64)
-                diff = s[i, sel] - s[j, sel]
-                w = None if self.weights is None else self.weights[sel]
-                aucs.append(weighted_auc(y, diff, w))
-        return [float(np.mean(aucs))]
+                v = w[i] - w[j]
+                t1 = v[i] - v[j]
+                dist = t1 * (v @ s[:, sel])
+                is_i = self.label_int[sel] == i
+                total += self._pair_auc(dist, is_i)
+        return [float(2.0 * total / (k * (k - 1)))]

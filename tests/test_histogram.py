@@ -9,7 +9,7 @@ def make(n=1024, f=6, b=32, seed=0):
     bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
     grad = rng.normal(size=n).astype(np.float32)
     hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
-    vals = np.stack([grad, hess], axis=1)
+    vals = np.stack([grad, hess], axis=0)  # [2, N] channel-major
     return bins, vals
 
 
@@ -18,7 +18,7 @@ def reference_hist(bins, vals, b):
     out = np.zeros((f, 2, b), dtype=np.float64)
     for i in range(n):
         for j in range(f):
-            out[j, :, bins[i, j]] += vals[i]
+            out[j, :, bins[i, j]] += vals[:, i]
     return out
 
 
@@ -40,8 +40,8 @@ def test_histogram_pallas_interpret_matches_xla():
 
 def test_histogram_masked_rows_contribute_nothing():
     bins, vals = make()
-    vals[500:] = 0.0  # masked-out rows
+    vals[:, 500:] = 0.0  # masked-out rows
     b = 32
     got = np.asarray(histogram_xla(jnp.asarray(bins), jnp.asarray(vals), b))
-    want = reference_hist(bins[:500], vals[:500], b)
+    want = reference_hist(bins[:500], vals[:, :500], b)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

@@ -28,7 +28,7 @@ def test_packed_kernel_matches_xla():
     rng = np.random.RandomState(1)
     n, c = 2048, 6
     bins = rng.randint(0, 15, size=(n, c)).astype(np.uint8)
-    vals = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    vals = jnp.asarray(rng.normal(size=(2, n)).astype(np.float32))
     packed = jnp.asarray(pack_nibbles(bins))
     ref = histogram_xla_masked(jnp.asarray(bins), vals, 128,
                                jnp.int32(100), jnp.int32(1500))

@@ -1,0 +1,106 @@
+"""Accuracy parity vs the reference on its own bundled example datasets.
+
+The reference pins CLI == Python consistency on exactly these configs
+(tests/python_package_test/test_consistency.py:11-41); here the goldens are
+the metric values of the reference CLI itself (v2.3.2, built from source,
+examples/*/train.conf run unmodified — see tests/data/golden_metrics.json).
+Bagging/feature-sampling RNG streams differ between implementations, so the
+assertions are quality windows around the reference values rather than bit
+parity — the same tolerance philosophy as the reference's GPU-vs-CPU AUC
+table (docs/GPU-Performance.rst:134-158).
+
+Default runs train a reduced number of iterations to keep the suite fast;
+set PARITY_ITERS=100 to reproduce the full reference runs.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config, parse_config_file
+from lightgbm_tpu.io.loader import DatasetLoader
+from lightgbm_tpu.metric.metric import create_metrics
+from lightgbm_tpu.objective import create_objective
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+with open(os.path.join(DATA, "golden_metrics.json")) as fh:
+    GOLDEN = json.load(fh)
+
+
+def run_config(name: str, num_iterations: int, overrides=None):
+    """Train examples/<name>/train.conf exactly like the CLI Application."""
+    conf_dir = os.path.join(DATA, name)
+    params = parse_config_file(os.path.join(conf_dir, "train.conf"))
+    params["num_iterations"] = str(num_iterations)
+    params.pop("output_model", None)
+    for k, v in (overrides or {}).items():
+        params[k] = str(v)
+    # data paths are relative to the config dir
+    params["data"] = os.path.join(conf_dir, params["data"])
+    if "valid_data" in params:
+        params["valid_data"] = os.path.join(conf_dir, params["valid_data"])
+    cfg = Config(params)
+    loader = DatasetLoader(cfg)
+    train_data = loader.load_from_file(cfg.data)
+    objective = create_objective(cfg.objective, cfg)
+    booster = create_boosting(cfg.boosting, cfg, train_data, objective)
+    booster.add_train_metrics(create_metrics(cfg.metric, cfg))
+    for valid_file in cfg.valid or []:
+        valid = loader.load_from_file(valid_file, reference=train_data)
+        booster.add_valid_data(valid, "valid_1", create_metrics(cfg.metric, cfg))
+    booster.train()
+    out = {}
+    for ds, metric, val, _ in booster.eval_train() + booster.eval_valid():
+        out["%s %s" % (ds, metric)] = val
+    return out
+
+
+def iters_for(default: int) -> int:
+    return int(os.environ.get("PARITY_ITERS", default))
+
+
+def check(name, got, it, tolerances):
+    want = GOLDEN[name][str(it)]
+    for key, tol in tolerances.items():
+        assert key in got, "missing metric %s (have %s)" % (key, sorted(got))
+        assert abs(got[key] - want[key]) < tol, (
+            "%s %s: got %.6f, reference %.6f (tol %.3f)"
+            % (name, key, got[key], want[key], tol))
+
+
+def test_parity_binary():
+    it = iters_for(25)
+    got = run_config("binary_classification", it)
+    check("binary_classification", got, it, {
+        "training auc": 0.02, "valid_1 auc": 0.025,
+        "training binary_logloss": 0.04, "valid_1 binary_logloss": 0.04})
+
+
+def test_parity_regression():
+    it = iters_for(25)
+    got = run_config("regression", it)
+    check("regression", got, it, {
+        "training l2": 0.02, "valid_1 l2": 0.02})
+
+
+def test_parity_multiclass():
+    it = iters_for(10)
+    got = run_config("multiclass_classification", it)
+    check("multiclass_classification", got, it, {
+        "training multi_logloss": 0.06, "valid_1 multi_logloss": 0.08,
+        "training auc_mu": 0.03, "valid_1 auc_mu": 0.05})
+
+
+def test_parity_lambdarank():
+    # valid tolerances are wide: 201 train queries + bagging_freq=1 make
+    # valid NDCG swing ~±0.03 across bagging seeds (reference's own
+    # trajectory spans 0.668-0.685 over iters 10-100); training NDCG is the
+    # controlled quantity
+    it = iters_for(10)
+    got = run_config("lambdarank", it)
+    check("lambdarank", got, it, {
+        "training ndcg@5": 0.04, "valid_1 ndcg@5": 0.08,
+        "training ndcg@1": 0.05, "valid_1 ndcg@1": 0.08})
