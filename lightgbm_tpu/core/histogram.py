@@ -82,6 +82,43 @@ def _padded_features(num_features: int, num_bins: int) -> int:
     return -(-num_features // fp) * fp
 
 
+def _hilo_split(vals, axis):
+    """f32 -> (hi, lo) bf16 concatenated on ``axis``: bf16 products against a
+    0/1 one-hot are exact and hi+lo recovers ~f32 precision (relative error
+    ~2^-16) in a single MXU pass instead of the 6-pass f32 emulation."""
+    hi = vals.astype(jnp.bfloat16)
+    lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return jnp.concatenate([hi, lo], axis=axis)
+
+
+def _accum_onehot_tiles(col, v4, out_ref, *, num_features: int,
+                        num_bins: int, contract_dim: int):
+    """The shared MXU tile loop: build each 128-lane one-hot tile (packing
+    ``128 // num_bins`` features per tile, or splitting one feature over
+    ``num_bins // 128`` tiles) and accumulate the [4, 128] contraction of the
+    (grad_hi, hess_hi, grad_lo, hess_lo) operand ``v4``."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1)
+    B = num_bins
+    fp = _features_per_tile(B)
+    tpf = max(1, B // _LANE)                 # lane tiles per feature (B > 128)
+    num_tiles = out_ref.shape[1] // _LANE
+    for t in range(num_tiles):
+        if B >= _LANE:
+            oh = (col(t // tpf) - (t % tpf) * _LANE) == iota
+        else:
+            oh = None
+            for j in range(fp):
+                f = t * fp + j
+                if f >= num_features:
+                    break
+                m = (col(f) + j * B) == iota
+                oh = m if oh is None else oh | m
+        acc = jax.lax.dot_general(
+            v4, oh.astype(jnp.bfloat16), (((contract_dim,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [4, 128]
+        out_ref[:, t * _LANE:(t + 1) * _LANE] += acc
+
+
 def _hist_kernel_mxu(win_ref, bins_ref, vals_ref, out_ref, *,
                      num_features: int, num_bins: int, row_tile: int,
                      packed: bool):
@@ -101,37 +138,16 @@ def _hist_kernel_mxu(win_ref, bins_ref, vals_ref, out_ref, *,
     def _accum():
         rows = base + jax.lax.broadcasted_iota(jnp.int32, (1, row_tile), 1)
         in_w = ((rows >= start) & (rows < start + count)).astype(jnp.float32)
-        vals = vals_ref[...] * in_w                      # [2, Nt] f32
-        hi = vals.astype(jnp.bfloat16)
-        lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-        v4 = jnp.concatenate([hi, lo], axis=0)           # [4, Nt] bf16
+        v4 = _hilo_split(vals_ref[...] * in_w, axis=0)   # [4, Nt] bf16
         bins = bins_ref[...].astype(jnp.int32)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1)
 
         def col(f):
             if packed:
                 return (bins[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
             return bins[:, f:f + 1]
 
-        B = num_bins
-        fp = _features_per_tile(B)
-        tpf = max(1, B // _LANE)             # lane tiles per feature (B > 128)
-        num_tiles = out_ref.shape[1] // _LANE
-        for t in range(num_tiles):
-            if B >= _LANE:
-                oh = (col(t // tpf) - (t % tpf) * _LANE) == iota
-            else:
-                oh = None
-                for j in range(fp):
-                    f = t * fp + j
-                    if f >= num_features:
-                        break
-                    m = (col(f) + j * B) == iota
-                    oh = m if oh is None else oh | m
-            acc = jax.lax.dot_general(
-                v4, oh.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # [4, 128]
-            out_ref[:, t * _LANE:(t + 1) * _LANE] += acc
+        _accum_onehot_tiles(col, v4, out_ref, num_features=num_features,
+                            num_bins=num_bins, contract_dim=1)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
@@ -203,6 +219,135 @@ def histogram_pallas(bins: jax.Array, values: jax.Array, num_bins: int,
                                    interpret=interpret)
 
 
+def _hist_kernel_rows(win_ref, rows_ref, out_ref, *, num_features: int,
+                      num_bins: int, row_tile: int, packed: bool,
+                      voff: int, bpc: int):
+    """Combined-row-store histogram: ``rows`` is [Nt, W] u8 with bin codes in
+    bytes [0, num_cols*bpc), grad/hess f32 little-endian at byte offsets
+    voff/voff+4.  One operand means the partitioned tree builder carries ONE
+    unpadded byte matrix (128-lane rows) instead of separate bins/values
+    arrays whose small-minor-dim layouts XLA pads 4-64x."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    start, count = win_ref[0], win_ref[1]
+    base = i * row_tile
+
+    @pl.when((base < start + count) & (base + row_tile > start))
+    def _accum():
+        w = rows_ref[...].astype(jnp.int32)              # [Nt, W]
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, (row_tile, 1), 0)
+        in_w = (pos >= start) & (pos < start + count)
+
+        def f32_at(off):
+            word = (w[:, off:off + 1] | (w[:, off + 1:off + 2] << 8)
+                    | (w[:, off + 2:off + 3] << 16)
+                    | (w[:, off + 3:off + 4] << 24))
+            return jax.lax.bitcast_convert_type(word, jnp.float32)
+
+        zero = jnp.float32(0.0)
+        g = jnp.where(in_w, f32_at(voff), zero)
+        h = jnp.where(in_w, f32_at(voff + 4), zero)
+        vals = jnp.concatenate([g, h], axis=1)           # [Nt, 2] f32
+        v4 = _hilo_split(vals, axis=1)                   # [Nt, 4] bf16
+
+        def col(f):
+            if packed:
+                return (w[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
+            if bpc == 2:
+                return w[:, 2 * f:2 * f + 1] | (w[:, 2 * f + 1:2 * f + 2] << 8)
+            return w[:, f:f + 1]
+
+        _accum_onehot_tiles(col, v4, out_ref, num_features=num_features,
+                            num_bins=num_bins, contract_dim=0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
+                                             "voff", "bpc", "row_tile",
+                                             "packed", "interpret"))
+def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
+                          count: jax.Array, *, num_features: int, voff: int,
+                          bpc: int = 1, packed: bool = False,
+                          row_tile: int = 2048,
+                          interpret: bool = False) -> jax.Array:
+    """Histogram over rows [start, start+count) of a combined row store.
+
+    rows: [R, W] u8 — bins bytes + f32 grad/hess at voff/voff+4 (see
+    _hist_kernel_rows).  Returns [F, 2, num_bins] f32."""
+    n, width = rows.shape
+    assert n % row_tile == 0, "pad rows to a multiple of row_tile"
+    assert _LANE % num_bins == 0 or num_bins % _LANE == 0, (
+        "num_bins must divide or be a multiple of 128 (use _pad_bins_pow2); "
+        "got %d" % num_bins)
+    f_pad = _padded_features(num_features, num_bins)
+    lanes = f_pad * num_bins
+    win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
+    kernel = functools.partial(_hist_kernel_rows, num_features=num_features,
+                               num_bins=num_bins, row_tile=row_tile,
+                               packed=packed, voff=voff, bpc=bpc)
+
+    def _in_idx(i, win_ref):
+        active = ((i * row_tile < win_ref[0] + win_ref[1])
+                  & ((i + 1) * row_tile > win_ref[0]))
+        return (jnp.where(active, i, 0), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // row_tile,),
+        in_specs=[pl.BlockSpec((row_tile, width), _in_idx)],
+        out_specs=pl.BlockSpec((4, lanes), lambda i, w: (0, 0)),
+    )
+    raw = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((4, lanes), jnp.float32),
+        interpret=interpret,
+    )(win, rows)
+    folded = raw[0:2] + raw[2:4]
+    return folded.reshape(2, f_pad, num_bins).transpose(1, 0, 2)[:num_features]
+
+
+def rows_split_xla(rows: jax.Array, num_features: int, voff: int,
+                   bpc: int = 1, packed: bool = False):
+    """Backend-agnostic unpack of a combined row store ->
+    (bins [N, F], values [2, N])."""
+    w = rows.astype(jnp.int32)
+    if packed:
+        bins = unpack_nibbles(rows[:, :(num_features + 1) // 2], num_features)
+    elif bpc == 2:
+        bins = w[:, 0:2 * num_features:2] | (w[:, 1:2 * num_features:2] << 8)
+    else:
+        bins = rows[:, :num_features]
+
+    def f32_at(off):
+        word = (w[:, off] | (w[:, off + 1] << 8) | (w[:, off + 2] << 16)
+                | (w[:, off + 3] << 24))
+        return jax.lax.bitcast_convert_type(word, jnp.float32)
+
+    values = jnp.stack([f32_at(voff), f32_at(voff + 4)], axis=0)
+    return bins, values
+
+
+def histogram_rows(rows: jax.Array, num_bins: int, start, count, *,
+                   num_features: int, voff: int, bpc: int = 1,
+                   packed: bool = False,
+                   use_pallas: bool | None = None) -> jax.Array:
+    """Masked histogram over a combined row store; Pallas on TPU."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas and rows.shape[0] % 2048 == 0:
+        return histogram_pallas_rows(rows, num_bins, start, count,
+                                     num_features=num_features, voff=voff,
+                                     bpc=bpc, packed=packed)
+    bins, values = rows_split_xla(rows, num_features, voff, bpc, packed)
+    pos = jnp.arange(rows.shape[0], dtype=jnp.int32)
+    in_w = ((pos >= start) & (pos < start + count)).astype(jnp.float32)
+    return histogram_xla(bins, values * in_w[None, :], num_bins)
+
+
 def _pick_tile(n: int) -> int | None:
     for tile in (4096, 2048, 1024):
         if n % tile == 0:
@@ -249,22 +394,6 @@ def histogram_xla_masked(bins: jax.Array, values: jax.Array, num_bins: int,
     pos = jnp.arange(bins.shape[0], dtype=jnp.int32)
     in_w = ((pos >= start) & (pos < start + count)).astype(values.dtype)
     return histogram_xla(bins, values * in_w[None, :], num_bins)
-
-
-def build_histogram_masked(bins: jax.Array, values: jax.Array, num_bins: int,
-                           start: jax.Array, count: jax.Array,
-                           use_pallas: bool | None = None,
-                           num_cols: int = 0) -> jax.Array:
-    """Masked-histogram dispatch: Pallas on TPU, masked segment-sum off.
-    ``num_cols`` > 0 marks ``bins`` as 4-bit nibble-packed with that many
-    logical columns."""
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
-    if use_pallas and bins.shape[0] % 2048 == 0:
-        return histogram_pallas_masked(bins, values, num_bins, start, count,
-                                       num_cols=num_cols)
-    return histogram_xla_masked(bins, values, num_bins, start, count,
-                                num_cols=num_cols)
 
 
 def partition_buckets(n: int, row_tile: int = 2048) -> tuple:

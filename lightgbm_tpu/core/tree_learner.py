@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import (build_histogram, build_histogram_masked, pack_nibbles,
+from .histogram import (build_histogram, histogram_rows, pack_nibbles,
                         partition_buckets, _pad_bins, _pad_bins_pow2)
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, per_feature_best_combined,
@@ -399,15 +399,16 @@ class _PState(NamedTuple):
     cmax: jax.Array             # [L] upper bounds
     begin: jax.Array            # [L] i32 window start (physical, partitioned)
     wcount: jax.Array           # [L] i32 window length (physical rows)
-    order: jax.Array            # [N] i32: position -> original row; the ONLY
-                                # partition state.  bins/values stay read-only
-                                # (loop-invariant) and windows gather their
-                                # rows per split — the reference GPU learner's
-                                # ordered-indices pattern
-                                # (gpu_tree_learner.cpp:818-867); rewriting
-                                # partitioned copies in the loop carry cost an
-                                # XLA buffer copy of the full matrices every
-                                # split
+    rows: jax.Array             # [N, W] u8 combined row store (leaf-
+                                # partitioned): bin bytes + f32 grad/hess +
+                                # s32 original-row order per row, W a
+                                # multiple of 128.
+    # Physically partitioned copies beat gather-by-index: window slices and
+    # write-backs are contiguous DMAs at full HBM bandwidth while row gathers
+    # cost ~5 ns/row in DMA descriptors (measured 4.7 ms vs 0.1 ms on a 512k
+    # window).  One unpadded byte matrix instead of separate bins/values/
+    # order carries: XLA lane-padded the small-minor-dim layouts 4-64x,
+    # which made its per-split buffer unification copies dominate.
     lsum_g: jax.Array           # [L] leaf gradient totals (forced splits)
     lsum_h: jax.Array           # [L] leaf hessian totals
     feat_used: jax.Array        # [F] bool: feature split somewhere (CEGB)
@@ -478,6 +479,52 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     f32 = jnp.float32
     buckets = partition_buckets(n)
     bsizes = jnp.asarray(buckets, dtype=jnp.int32)
+
+    # ---- combined row store ----
+    # One [N, W] u8 matrix carries bin bytes + f32 grad/hess + the s32 row
+    # order, W a multiple of 128 so the {1,0:T(8,128)(4,1)} layout has NO
+    # lane padding: every slice/permute/write-back of partition state moves
+    # exactly the stored bytes.  Separate bins/values/order carries got
+    # 4-64x lane-padded layouts, which turned XLA's per-split buffer
+    # unification copies into the dominant cost of the whole tree build.
+    bpc = 2 if bins.dtype == jnp.uint16 else 1
+    f_cols = packed_cols or ncols      # histogrammed bin columns
+    nbytes_bins = ncols * bpc
+    voff = -(-nbytes_bins // 4) * 4
+    W = -(-(voff + 12) // 128) * 128
+    if bpc == 2:
+        bins_u8 = jax.lax.bitcast_convert_type(
+            bins, jnp.uint8).reshape(n, nbytes_bins)
+    else:
+        bins_u8 = bins.astype(jnp.uint8)
+    parts = [bins_u8]
+    if voff > nbytes_bins:
+        parts.append(jnp.zeros((n, voff - nbytes_bins), jnp.uint8))
+    parts.append(jax.lax.bitcast_convert_type(grad.astype(f32), jnp.uint8))
+    parts.append(jax.lax.bitcast_convert_type(hess.astype(f32), jnp.uint8))
+    parts.append(jax.lax.bitcast_convert_type(
+        jnp.arange(n, dtype=jnp.int32), jnp.uint8))
+    if W > voff + 12:
+        parts.append(jnp.zeros((n, W - voff - 12), jnp.uint8))
+    rows0 = jnp.concatenate(parts, axis=1)
+
+    def hist_rows(rows_mat, start, count):
+        return histogram_rows(rows_mat, num_bins, start, count,
+                              num_features=f_cols, voff=voff, bpc=bpc,
+                              packed=bool(packed_cols),
+                              use_pallas=use_pallas)
+
+    def col_from_rows(wi, gcol):
+        """Dynamic bin-column extract from [R, W] i32 row-store bytes."""
+        lanes = jnp.arange(W, dtype=jnp.int32)
+        if packed_cols:
+            byte = jnp.sum(wi * (lanes == gcol // 2), axis=1)
+            return (byte >> (4 * (gcol % 2))) & 15
+        if bpc == 2:
+            lo = jnp.sum(wi * (lanes == 2 * gcol), axis=1)
+            hi = jnp.sum(wi * (lanes == 2 * gcol + 1), axis=1)
+            return lo | (hi << 8)
+        return jnp.sum(wi * (lanes == gcol), axis=1)
 
     def unpack(h, sg, sh):
         """Group-column histogram [G, 2, Bg] -> per-feature [F, 2, B] with the
@@ -552,34 +599,23 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     vmapped_best = jax.vmap(best_of, in_axes=(0, 0, 0, 0, 0, 0, None))
 
     def make_branch(R):
-        """Partition the parent window (size <= R) of the row order and
-        histogram the smaller child; bins/values are read-only closures.
+        """Partition the parent window (size <= R) of the row store and
+        histogram the smaller child.
 
-        The rows of the window are gathered by their order indices (the
-        reference GPU learner's ordered grad/hess copies,
-        gpu_tree_learner.cpp:818-867), routed, and the stable partition is
-        applied to the ORDER only; the child histogram streams the freshly
-        gathered leaf-contiguous rows with tiles outside its window skipped."""
+        Cost scales with the bucket size R: one contiguous slice, a
+        stable-partition row scatter of the slice (the reference's
+        DataPartition::Split, data_partition.hpp:113 — grad/hess/order bytes
+        ride along in the same rows), one contiguous write-back, and a
+        histogram whose out-of-window tiles are skipped."""
 
-        def branch(order, b, c, feat_id, thr, default_left,
+        def branch(rows, b, c, feat_id, thr, default_left,
                    is_cat, bitset, left_smaller):
             s0 = jnp.clip(b, 0, n - R)
             rel_b = b - s0
-            ordw = jax.lax.dynamic_slice(order, (s0,), (R,))
-            binsw = jnp.take(bins, ordw, axis=0, unique_indices=True)
+            w = jax.lax.dynamic_slice(rows, (s0, 0), (R, W))
             iota = jnp.arange(R, dtype=jnp.int32)
-            gcol = _feature_column(feat_id, feat)
-            if packed_cols:
-                # 4-bit storage (dense_nbits_bin.hpp): select the byte column,
-                # then the nibble
-                byte = jnp.sum(binsw.astype(jnp.int32)
-                               * (jnp.arange(ncols, dtype=jnp.int32)
-                                  == gcol // 2), axis=1)
-                colw = (byte >> (4 * (gcol % 2))) & 15
-            else:
-                colw = jnp.sum(binsw.astype(jnp.int32)
-                               * (jnp.arange(ncols, dtype=jnp.int32)
-                                  == gcol), axis=1)
+            colw = col_from_rows(w.astype(jnp.int32),
+                                 _feature_column(feat_id, feat))
             colw = _unfold_bin(colw, feat_id, feat)
             glw = _route_left(colw, thr, default_left,
                               feat.missing_type[feat_id],
@@ -593,31 +629,22 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             cr = jnp.cumsum(inw & ~gl, dtype=jnp.int32)
             dest = jnp.where(gl, rel_b + cl - 1,
                              jnp.where(inw, rel_b + nl + cr - 1, iota))
-            src = jnp.zeros((R,), jnp.int32).at[dest].set(
-                iota, unique_indices=True)
-            ordw = jnp.take(ordw, src, unique_indices=True)
-            order = jax.lax.dynamic_update_slice(order, ordw, (s0,))
+            w = jnp.zeros_like(w).at[dest].set(w, unique_indices=True)
+            rows = jax.lax.dynamic_update_slice(rows, w, (s0, 0))
             # smaller child's histogram from the permuted window; the side is
             # chosen from replicated global estimates so every shard streams
             # the same child (required for the psum below)
             rel_s = jnp.where(left_smaller, rel_b, rel_b + nl)
             cnt_s = jnp.where(left_smaller, nl, c - nl)
-            binsc = jnp.take(binsw, src, axis=0, unique_indices=True)
-            valsc = jnp.take(values, ordw, axis=1, unique_indices=True)
-            hist_small = build_histogram_masked(binsc, valsc, num_bins,
-                                                rel_s, cnt_s, use_pallas,
-                                                num_cols=packed_cols)
-            return order, hist_small, nl
+            hist_small = hist_rows(w, rel_s, cnt_s)
+            return rows, hist_small, nl
 
         return branch
 
     branches = [make_branch(R) for R in buckets]
 
     # ---- root ----
-    values = jnp.stack([grad, hess], axis=0)
-    hist0 = build_histogram_masked(bins, values, num_bins, jnp.int32(0),
-                                   jnp.int32(n), use_pallas,
-                                   num_cols=packed_cols)
+    hist0 = hist_rows(rows0, jnp.int32(0), jnp.int32(n))
     sum_g = jnp.sum(grad)
     sum_h = jnp.sum(hess)
     if axis_name:
@@ -653,7 +680,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     cmax=jnp.full((L,), np.inf, dtype=f32),
                     begin=zl(jnp.int32),
                     wcount=zl(jnp.int32).at[0].set(n),
-                    order=jnp.arange(n, dtype=jnp.int32),
+                    rows=rows0,
                     lsum_g=zl().at[0].set(sum_g),
                     lsum_h=zl().at[0].set(sum_h),
                     feat_used=used0,
@@ -676,122 +703,137 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # one failed entry invalidates the rest of the schedule's leaf ids
             st = st._replace(force_on=st.force_on & (~in_sched | fvalid))
 
-        def do_split(st: _PState) -> _PState:
-            t = st.tree
-            b = BestSplit(*[x[leaf] for x in st.bests])
-            if force_now is not None:
-                fbest, fvalid = force_now
-                b = BestSplit(*[jnp.where(fvalid, fx, x)
-                                for fx, x in zip(fbest, b)])
-            wb, wc = st.begin[leaf], st.wcount[leaf]
-            left_smaller = b.left_count <= b.right_count
-            which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
-            order, hist_small, nl = jax.lax.switch(
-                which, branches, st.order, wb, wc,
-                b.feature, b.threshold, b.default_left,
-                feat.is_categorical[b.feature], b.cat_bitset, left_smaller)
-            if axis_name:
-                # per-split histogram Allreduce of the smaller child
-                # (the reference's ReduceScatter at
-                # data_parallel_tree_learner.cpp:161, as psum)
-                hist_small = jax.lax.psum(hist_small, axis_name)
+        # The split always executes — a dead iteration (ok=False) partitions
+        # an EMPTY window of the smallest bucket (identity permutation, zero
+        # histogram) and every state write below is masked by ``ok``.  An
+        # actual lax.cond around the split forced XLA to materialize
+        # unification copies of the partitioned matrices every iteration.
+        t = st.tree
+        b = BestSplit(*[x[leaf] for x in st.bests])
+        if force_now is not None:
+            fbest, fvalid = force_now
+            b = BestSplit(*[jnp.where(fvalid, fx, x)
+                            for fx, x in zip(fbest, b)])
+        wb = jnp.where(ok, st.begin[leaf], 0)
+        wc = jnp.where(ok, st.wcount[leaf], 0)
+        left_smaller = b.left_count <= b.right_count
+        which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
+        rows_new, hist_small, nl = jax.lax.switch(
+            which, branches, st.rows, wb, wc,
+            b.feature, b.threshold, b.default_left,
+            feat.is_categorical[b.feature], b.cat_bitset, left_smaller)
+        if axis_name:
+            # per-split histogram Allreduce of the smaller child
+            # (the reference's ReduceScatter at
+            # data_parallel_tree_learner.cpp:161, as psum)
+            hist_small = jax.lax.psum(hist_small, axis_name)
 
-            hist_larger = st.hist[leaf] - hist_small
-            hist_left = jnp.where(left_smaller, hist_small, hist_larger)
-            hist_right = jnp.where(left_smaller, hist_larger, hist_small)
-            hist_new = st.hist.at[leaf].set(hist_left).at[k].set(hist_right)
+        def sel(new, old):
+            """Masked state write: keep ``old`` on dead iterations."""
+            return jnp.where(ok, new, old)
 
-            begin = st.begin.at[k].set(wb + nl)
-            wcount = st.wcount.at[leaf].set(nl).at[k].set(wc - nl)
+        hist_larger = st.hist[leaf] - hist_small
+        hist_left = jnp.where(left_smaller, hist_small, hist_larger)
+        hist_right = jnp.where(left_smaller, hist_larger, hist_small)
+        hist_new = st.hist.at[leaf].set(sel(hist_left, st.hist[leaf])) \
+                          .at[k].set(sel(hist_right, st.hist[k]))
 
-            # monotone constraint propagation
-            # (monotone_constraints.hpp UpdateConstraints)
-            pmin, pmax = st.cmin[leaf], st.cmax[leaf]
-            if has_monotone and feat.monotone is not None:
-                mono_f = feat.monotone[b.feature]
-            else:
-                mono_f = jnp.int32(0)
-            is_num = ~feat.is_categorical[b.feature]
-            mid = (b.left_output + b.right_output) * 0.5
-            lmin = jnp.where(is_num & (mono_f < 0), jnp.maximum(pmin, mid), pmin)
-            lmax = jnp.where(is_num & (mono_f > 0), jnp.minimum(pmax, mid), pmax)
-            rmin = jnp.where(is_num & (mono_f > 0), jnp.maximum(pmin, mid), pmin)
-            rmax = jnp.where(is_num & (mono_f < 0), jnp.minimum(pmax, mid), pmax)
-            cmin_new = st.cmin.at[leaf].set(lmin).at[k].set(rmin)
-            cmax_new = st.cmax.at[leaf].set(lmax).at[k].set(rmax)
+        begin = st.begin.at[k].set(wb + nl)
+        wcount = st.wcount.at[leaf].set(nl).at[k].set(wc - nl)
 
-            feat_used = (st.feat_used | (jnp.arange(f) == b.feature)
-                         if cegb is not None else st.feat_used)
-            child_best = vmapped_best(
-                jnp.stack([hist_left, hist_right]),
-                jnp.stack([b.left_sum_grad, b.right_sum_grad]),
-                jnp.stack([b.left_sum_hess, b.right_sum_hess]),
-                jnp.stack([b.left_count, b.right_count]),
-                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
-                feat_used)
-            bests = _bests_update(st.bests, leaf,
-                                  BestSplit(*[x[0] for x in child_best]))
-            bests = _bests_update(bests, k, BestSplit(*[x[1] for x in child_best]))
+        # monotone constraint propagation
+        # (monotone_constraints.hpp UpdateConstraints)
+        pmin, pmax = st.cmin[leaf], st.cmax[leaf]
+        if has_monotone and feat.monotone is not None:
+            mono_f = feat.monotone[b.feature]
+        else:
+            mono_f = jnp.int32(0)
+        is_num = ~feat.is_categorical[b.feature]
+        mid = (b.left_output + b.right_output) * 0.5
+        lmin = jnp.where(is_num & (mono_f < 0), jnp.maximum(pmin, mid), pmin)
+        lmax = jnp.where(is_num & (mono_f > 0), jnp.minimum(pmax, mid), pmax)
+        rmin = jnp.where(is_num & (mono_f > 0), jnp.maximum(pmin, mid), pmin)
+        rmax = jnp.where(is_num & (mono_f < 0), jnp.minimum(pmax, mid), pmax)
+        cmin_new = st.cmin.at[leaf].set(lmin).at[k].set(rmin)
+        cmax_new = st.cmax.at[leaf].set(lmax).at[k].set(rmax)
 
-            # parent child-pointer fixup (tree.h:338-346)
-            parent = t.leaf_parent[leaf]
-            pidx = jnp.maximum(parent, 0)
-            lc = t.left_child
-            rc = t.right_child
-            lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~leaf),
-                                           node, lc[pidx]))
-            rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~leaf),
-                                           node, rc[pidx]))
+        feat_used = (st.feat_used | (jnp.arange(f) == b.feature)
+                     if cegb is not None else st.feat_used)
+        child_best = vmapped_best(
+            jnp.stack([hist_left, hist_right]),
+            jnp.stack([b.left_sum_grad, b.right_sum_grad]),
+            jnp.stack([b.left_sum_hess, b.right_sum_hess]),
+            jnp.stack([b.left_count, b.right_count]),
+            jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+            feat_used)
+        bests = _bests_update(st.bests, leaf,
+                              BestSplit(*[x[0] for x in child_best]))
+        bests = _bests_update(bests, k, BestSplit(*[x[1] for x in child_best]))
 
-            tree_new = TreeArrays(
-                split_feature=t.split_feature.at[node].set(b.feature),
-                threshold_bin=t.threshold_bin.at[node].set(b.threshold),
-                split_gain=t.split_gain.at[node].set(b.gain),
-                default_left=t.default_left.at[node].set(b.default_left),
-                left_child=lc.at[node].set(~leaf),
-                right_child=rc.at[node].set(~k),
-                internal_value=t.internal_value.at[node].set(t.leaf_value[leaf]),
-                internal_weight=t.internal_weight.at[node].set(t.leaf_weight[leaf]),
-                internal_count=t.internal_count.at[node].set(
-                    b.left_count + b.right_count),
-                leaf_value=t.leaf_value.at[leaf].set(
-                    jnp.nan_to_num(b.left_output)).at[k].set(
-                    jnp.nan_to_num(b.right_output)),
-                leaf_weight=t.leaf_weight.at[leaf].set(
-                    b.left_sum_hess).at[k].set(b.right_sum_hess),
-                leaf_count=t.leaf_count.at[leaf].set(
-                    b.left_count).at[k].set(b.right_count),
-                leaf_parent=t.leaf_parent.at[leaf].set(node).at[k].set(node),
-                leaf_depth=t.leaf_depth.at[k].set(
-                    t.leaf_depth[leaf] + 1).at[leaf].add(1),
-                cat_bitset=t.cat_bitset.at[node].set(b.cat_bitset),
-                num_leaves=t.num_leaves + 1,
-                row_leaf=t.row_leaf)
-            lsum_g = st.lsum_g.at[leaf].set(b.left_sum_grad).at[k].set(
-                b.right_sum_grad)
-            lsum_h = st.lsum_h.at[leaf].set(b.left_sum_hess).at[k].set(
-                b.right_sum_hess)
-            return _PState(tree=tree_new, hist=hist_new, bests=bests,
-                           cont=st.cont, cmin=cmin_new, cmax=cmax_new,
-                           begin=begin, wcount=wcount,
-                           order=order,
-                           lsum_g=lsum_g, lsum_h=lsum_h, feat_used=feat_used,
-                           force_on=st.force_on)
+        # parent child-pointer fixup (tree.h:338-346)
+        parent = t.leaf_parent[leaf]
+        pidx = jnp.maximum(parent, 0)
+        lc = t.left_child
+        rc = t.right_child
+        lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~leaf),
+                                       node, lc[pidx]))
+        rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~leaf),
+                                       node, rc[pidx]))
 
-        return jax.lax.cond(ok, do_split,
-                            lambda s: s._replace(cont=jnp.bool_(False)), st)
+        tree_new = TreeArrays(
+            split_feature=t.split_feature.at[node].set(b.feature),
+            threshold_bin=t.threshold_bin.at[node].set(b.threshold),
+            split_gain=t.split_gain.at[node].set(b.gain),
+            default_left=t.default_left.at[node].set(b.default_left),
+            left_child=lc.at[node].set(~leaf),
+            right_child=rc.at[node].set(~k),
+            internal_value=t.internal_value.at[node].set(t.leaf_value[leaf]),
+            internal_weight=t.internal_weight.at[node].set(t.leaf_weight[leaf]),
+            internal_count=t.internal_count.at[node].set(
+                b.left_count + b.right_count),
+            leaf_value=t.leaf_value.at[leaf].set(
+                jnp.nan_to_num(b.left_output)).at[k].set(
+                jnp.nan_to_num(b.right_output)),
+            leaf_weight=t.leaf_weight.at[leaf].set(
+                b.left_sum_hess).at[k].set(b.right_sum_hess),
+            leaf_count=t.leaf_count.at[leaf].set(
+                b.left_count).at[k].set(b.right_count),
+            leaf_parent=t.leaf_parent.at[leaf].set(node).at[k].set(node),
+            leaf_depth=t.leaf_depth.at[k].set(
+                t.leaf_depth[leaf] + 1).at[leaf].add(1),
+            cat_bitset=t.cat_bitset.at[node].set(b.cat_bitset),
+            num_leaves=t.num_leaves + 1,
+            row_leaf=t.row_leaf)
+        lsum_g = st.lsum_g.at[leaf].set(b.left_sum_grad).at[k].set(
+            b.right_sum_grad)
+        lsum_h = st.lsum_h.at[leaf].set(b.left_sum_hess).at[k].set(
+            b.right_sum_hess)
+        small_new = (tree_new, bests, cmin_new, cmax_new, begin, wcount,
+                     lsum_g, lsum_h, feat_used)
+        small_old = (t, st.bests, st.cmin, st.cmax, st.begin, st.wcount,
+                     st.lsum_g, st.lsum_h, st.feat_used)
+        (tree_m, bests_m, cmin_m, cmax_m, begin_m, wcount_m, lsg_m, lsh_m,
+         fu_m) = jax.tree_util.tree_map(sel, small_new, small_old)
+        return _PState(tree=tree_m, hist=hist_new, bests=bests_m,
+                       cont=ok, cmin=cmin_m, cmax=cmax_m,
+                       begin=begin_m, wcount=wcount_m,
+                       rows=rows_new,
+                       lsum_g=lsg_m, lsum_h=lsh_m, feat_used=fu_m,
+                       force_on=st.force_on)
 
     if L > 1:
         state = jax.lax.fori_loop(1, L, body, state)
 
     # reconstruct per-row leaf assignment from the windows + permutation
     t = state.tree
+    order = jax.lax.bitcast_convert_type(
+        state.rows[:, voff + 8:voff + 12], jnp.int32).reshape(n)
     valid = (jnp.arange(L) < t.num_leaves) & (state.wcount > 0)
     marks = jnp.zeros((n,), jnp.int32).at[
         jnp.where(valid, state.begin, n)].set(
         jnp.arange(L, dtype=jnp.int32) + 1, mode="drop")
     leaf_of_pos = _ffill_nonzero(marks) - 1
-    row_leaf = jnp.zeros((n,), jnp.int32).at[state.order].set(
+    row_leaf = jnp.zeros((n,), jnp.int32).at[order].set(
         leaf_of_pos, unique_indices=True)
     return t._replace(row_leaf=row_leaf)
 

@@ -153,7 +153,8 @@ class DatasetLoader:
         if is_libsvm:
             # LibSVM's leading target IS the label; there are no positional
             # weight/group/ignore columns to resolve (parser.hpp LibSVM branch)
-            for spec, nm in ((cfg.weight_column, "weight_column"),
+            for spec, nm in ((cfg.label_column, "label_column"),
+                             (cfg.weight_column, "weight_column"),
                              (cfg.group_column, "group_column"),
                              (cfg.ignore_column, "ignore_column")):
                 if str(spec or ""):

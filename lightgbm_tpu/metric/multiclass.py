@@ -84,6 +84,11 @@ class AucMuMetric(Metric):
                           k * k, len(weights))
             self.class_weights = np.asarray(weights, dtype=np.float64
                                             ).reshape(k, k)
+            off_diag = ~np.eye(k, dtype=bool)
+            if (np.abs(self.class_weights[off_diag]) < 1e-35).any():
+                from ..utils.log import Log
+                Log.fatal("AUC-mu matrix must have non-zero values for "
+                          "non-diagonal entries.")
             np.fill_diagonal(self.class_weights, 0.0)
         else:
             self.class_weights = 1.0 - np.eye(k)
