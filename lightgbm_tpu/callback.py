@@ -1,15 +1,23 @@
-"""Training callbacks (python-package/lightgbm/callback.py:55-150)."""
+"""Training callbacks.
+
+The callback PROTOCOL is the reference's public contract
+(python-package/lightgbm/callback.py): ``train``/``cv`` call each callback
+with a ``CallbackEnv``, callbacks are ordered by an ``order`` attribute and
+may set ``before_iteration``, and early stopping ends training by raising
+``EarlyStopException``.  The implementations here are class-based: each
+callback is a small object whose ``__call__`` takes the env, which keeps
+per-callback state on the instance instead of in closures.
+"""
 from __future__ import annotations
 
 import collections
-from operator import gt, lt
+from typing import Dict, List, Optional
 
 from .utils.log import Log
 
 
 class EarlyStopException(Exception):
-    """Raised by the early-stopping callback to end training
-    (callback.py:14-28)."""
+    """Raised by the early-stopping callback to end training."""
 
     def __init__(self, best_iteration, best_score):
         super().__init__()
@@ -24,6 +32,7 @@ CallbackEnv = collections.namedtuple(
 
 
 def _format_eval_result(value, show_stdv=True):
+    """(data_name, eval_name, value, bigger_better[, stdv]) -> log text."""
     if len(value) == 4:
         return "%s's %s: %g" % (value[0], value[1], value[2])
     if len(value) == 5:
@@ -33,136 +42,183 @@ def _format_eval_result(value, show_stdv=True):
     raise ValueError("Wrong metric value")
 
 
+class _PrintEvaluation:
+    order = 10
+    before_iteration = False
+
+    def __init__(self, period: int, show_stdv: bool) -> None:
+        self.period = period
+        self.show_stdv = show_stdv
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.period <= 0 or not env.evaluation_result_list:
+            return
+        if (env.iteration + 1) % self.period:
+            return
+        line = "\t".join(_format_eval_result(v, self.show_stdv)
+                         for v in env.evaluation_result_list)
+        Log.info("[%d]\t%s", env.iteration + 1, line)
+
+
 def print_evaluation(period=1, show_stdv=True):
-    """Log evaluation results every ``period`` iterations (callback.py:55)."""
-    def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list \
-                and (env.iteration + 1) % period == 0:
-            result = "\t".join(_format_eval_result(x, show_stdv)
-                               for x in env.evaluation_result_list)
-            Log.info("[%d]\t%s", env.iteration + 1, result)
-    _callback.order = 10
-    return _callback
+    """Log evaluation results every ``period`` iterations."""
+    return _PrintEvaluation(period, show_stdv)
+
+
+class _RecordEvaluation:
+    order = 20
+    before_iteration = False
+
+    def __init__(self, eval_result: dict) -> None:
+        if not isinstance(eval_result, dict):
+            raise TypeError("eval_result should be a dictionary")
+        eval_result.clear()
+        self.eval_result = eval_result
+
+    def __call__(self, env: CallbackEnv) -> None:
+        for entry in env.evaluation_result_list:
+            data_name, eval_name, value = entry[0], entry[1], entry[2]
+            per_data = self.eval_result.setdefault(
+                data_name, collections.OrderedDict())
+            per_data.setdefault(eval_name, []).append(value)
 
 
 def record_evaluation(eval_result: dict):
-    """Record evaluation history into ``eval_result`` (callback.py:79)."""
-    if not isinstance(eval_result, dict):
-        raise TypeError("eval_result should be a dictionary")
-    eval_result.clear()
+    """Record evaluation history into ``eval_result``."""
+    return _RecordEvaluation(eval_result)
 
-    def _init(env: CallbackEnv) -> None:
-        for data_name, eval_name, _, _ in env.evaluation_result_list:
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
 
-    def _callback(env: CallbackEnv) -> None:
-        if not eval_result:
-            _init(env)
-        for data_name, eval_name, result, _ in env.evaluation_result_list:
-            eval_result[data_name][eval_name].append(result)
-    _callback.order = 20
-    return _callback
+class _ResetParameter:
+    order = 10
+    before_iteration = True
+
+    def __init__(self, schedules: dict) -> None:
+        self.schedules = schedules
+
+    def _value_at(self, key, schedule, env: CallbackEnv):
+        step = env.iteration - env.begin_iteration
+        if isinstance(schedule, list):
+            if len(schedule) != env.end_iteration - env.begin_iteration:
+                raise ValueError(
+                    "Length of list %r has to equal to 'num_boost_round'."
+                    % key)
+            return schedule[step]
+        return schedule(step)
+
+    def __call__(self, env: CallbackEnv) -> None:
+        changed = {k: v for k, v in
+                   ((key, self._value_at(key, sched, env))
+                    for key, sched in self.schedules.items())
+                   if env.params.get(k) != v}
+        if changed:
+            env.model.reset_parameter(changed)
+            env.params.update(changed)
 
 
 def reset_parameter(**kwargs):
-    """Reset parameters on a schedule: value list per iteration or
-    callable(iteration) (callback.py:106)."""
-    def _callback(env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(
-                        "Length of list %r has to equal to 'num_boost_round'."
-                        % key)
-                new_param = value[env.iteration - env.begin_iteration]
-            else:
-                new_param = value(env.iteration - env.begin_iteration)
-            if new_param != env.params.get(key, None):
-                new_parameters[key] = new_param
-        if new_parameters:
-            env.model.reset_parameter(new_parameters)
-            env.params.update(new_parameters)
-    _callback.before_iteration = True
-    _callback.order = 10
-    return _callback
+    """Reset parameters on a schedule: a per-iteration value list or a
+    ``callable(iteration) -> value`` per parameter name."""
+    return _ResetParameter(kwargs)
 
 
-def early_stopping(stopping_rounds, first_metric_only=False, verbose=True):
-    """Early stopping on validation metrics (callback.py:150-222)."""
-    best_score = []
-    best_iter = []
-    best_score_list = []
-    cmp_op = []
-    enabled = [True]
-    first_metric = [""]
+class _MetricTracker:
+    """Best-so-far state for one (dataset, metric) column."""
 
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(
-            env.params.get(alias, "") == "dart"
-            for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
+    def __init__(self, bigger_better: bool) -> None:
+        self.sign = 1.0 if bigger_better else -1.0
+        self.best = float("-inf")
+        self.best_iteration = 0
+        self.best_entries: Optional[List] = None
+
+    def update(self, score: float, iteration: int, entries) -> None:
+        if self.best_entries is None or self.sign * score > self.sign * self.best:
+            self.best = score
+            self.best_iteration = iteration
+            self.best_entries = entries
+
+
+class _EarlyStopping:
+    order = 30
+    before_iteration = False
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool,
+                 verbose: bool) -> None:
+        self.rounds = stopping_rounds
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.trackers: Dict[int, _MetricTracker] = {}
+        self.enabled = True
+        self.first_metric = ""
+        self._started = False
+
+    # -- setup --
+
+    def _start(self, env: CallbackEnv) -> None:
+        self._started = True
+        boosting = next((env.params[a] for a in
+                         ("boosting", "boosting_type", "boost")
+                         if env.params.get(a)), "")
+        if boosting == "dart":
+            self.enabled = False
             Log.warning("Early stopping is not available in dart mode")
             return
         if not env.evaluation_result_list:
             raise ValueError("For early stopping, at least one dataset and "
                              "eval metric is required for evaluation")
-        if verbose:
+        if self.verbose:
             Log.info("Training until validation scores don't improve for %d "
-                     "rounds", stopping_rounds)
-        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
-        for eval_ret in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if eval_ret[3]:  # bigger is better
-                best_score.append(float("-inf"))
-                cmp_op.append(gt)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lt)
+                     "rounds", self.rounds)
+        self.first_metric = self._metric_name(env.evaluation_result_list[0])
+        for i, entry in enumerate(env.evaluation_result_list):
+            self.trackers[i] = _MetricTracker(bigger_better=bool(entry[3]))
 
-    def _final_iteration_check(env, eval_name_splitted, i) -> None:
-        if env.iteration == env.end_iteration - 1:
-            if verbose:
-                Log.info("Did not meet early stopping. Best iteration is:\n[%d]\t%s",
-                         best_iter[i] + 1,
-                         "\t".join(_format_eval_result(x)
-                                   for x in best_score_list[i]))
-                if first_metric_only:
-                    Log.info("Evaluated only: %s", eval_name_splitted[-1])
-            raise EarlyStopException(best_iter[i], best_score_list[i])
+    @staticmethod
+    def _metric_name(entry) -> str:
+        return entry[1].split(" ")[-1]
 
-    def _callback(env: CallbackEnv) -> None:
-        if not cmp_op:
-            _init(env)
-        if not enabled[0]:
+    def _siblings(self, env: CallbackEnv, i: int):
+        """This entry first, then the other entries of the same dataset."""
+        mine = env.evaluation_result_list[i]
+        rest = [e for j, e in enumerate(env.evaluation_result_list)
+                if j != i and e[0] == mine[0]]
+        return [mine] + rest
+
+    def _stop(self, tracker: _MetricTracker, metric_name: str, met: bool):
+        if self.verbose:
+            verb = "Early stopping" if met else "Did not meet early stopping"
+            Log.info("%s, best iteration is:\n[%d]\t%s", verb,
+                     tracker.best_iteration + 1,
+                     "\t".join(_format_eval_result(x)
+                               for x in tracker.best_entries))
+            if self.first_metric_only:
+                Log.info("Evaluated only: %s", metric_name)
+        raise EarlyStopException(tracker.best_iteration, tracker.best_entries)
+
+    # -- per-iteration --
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if not self._started:
+            self._start(env)
+        if not self.enabled:
             return
-        for i in range(len(env.evaluation_result_list)):
-            score = env.evaluation_result_list[i][2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list[i:i + 1] \
-                    + [x for j, x in enumerate(env.evaluation_result_list)
-                       if j != i and x[0] == env.evaluation_result_list[i][0]]
-            eval_name_splitted = env.evaluation_result_list[i][1].split(" ")
-            if first_metric_only and first_metric[0] != eval_name_splitted[-1]:
+        last = env.iteration == env.end_iteration - 1
+        for i, entry in enumerate(env.evaluation_result_list):
+            tracker = self.trackers[i]
+            tracker.update(entry[2], env.iteration, self._siblings(env, i))
+            name = self._metric_name(entry)
+            if self.first_metric_only and name != self.first_metric:
                 continue
-            if env.evaluation_result_list[i][0] == "cv_agg" \
-                    and eval_name_splitted[0] == "train":
+            # training metrics never trigger stopping
+            if entry[0] == "training" or (
+                    entry[0] == "cv_agg" and entry[1].split(" ")[0] == "train"):
                 continue
-            if env.evaluation_result_list[i][0] == "training":
-                continue  # train metric never triggers stopping
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    Log.info("Early stopping, best iteration is:\n[%d]\t%s",
-                             best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x)
-                                       for x in best_score_list[i]))
-                    if first_metric_only:
-                        Log.info("Evaluated only: %s", eval_name_splitted[-1])
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            _final_iteration_check(env, eval_name_splitted, i)
-    _callback.order = 30
-    return _callback
+            if env.iteration - tracker.best_iteration >= self.rounds:
+                self._stop(tracker, name, met=True)
+            if last:
+                self._stop(tracker, name, met=False)
+
+
+def early_stopping(stopping_rounds, first_metric_only=False, verbose=True):
+    """Stop training when no validation metric improved for
+    ``stopping_rounds`` consecutive iterations."""
+    return _EarlyStopping(stopping_rounds, first_metric_only, verbose)

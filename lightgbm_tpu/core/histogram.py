@@ -343,9 +343,7 @@ def histogram_rows(rows: jax.Array, num_bins: int, start, count, *,
                                      num_features=num_features, voff=voff,
                                      bpc=bpc, packed=packed)
     bins, values = rows_split_xla(rows, num_features, voff, bpc, packed)
-    pos = jnp.arange(rows.shape[0], dtype=jnp.int32)
-    in_w = ((pos >= start) & (pos < start + count)).astype(jnp.float32)
-    return histogram_xla(bins, values * in_w[None, :], num_bins)
+    return histogram_xla_masked(bins, values, num_bins, start, count)
 
 
 def _pick_tile(n: int) -> int | None:
@@ -397,11 +395,16 @@ def histogram_xla_masked(bins: jax.Array, values: jax.Array, num_bins: int,
 
 
 def partition_buckets(n: int, row_tile: int = 2048) -> tuple:
-    """Static window-slice sizes (rows): powers of 4 × row_tile, plus n."""
+    """Static window-slice sizes (rows): powers of 2 × row_tile, plus n.
+
+    Per-split partition/histogram cost scales with the BUCKET covering the
+    window, so tighter spacing buys back the slack (2x spacing: <=2x the
+    window; 4x spacing averaged ~2.5x) at the price of a few more compiled
+    switch branches."""
     sizes = []
     b = row_tile
     while b < n:
         sizes.append(b)
-        b *= 4
+        b *= 2
     sizes.append(n)
     return tuple(sizes)

@@ -430,7 +430,8 @@ def _ffill_nonzero(x: jax.Array) -> jax.Array:
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "params", "num_bins",
                      "use_pallas", "has_categorical", "has_monotone",
-                     "feat_num_bins", "packed_cols", "axis_name"))
+                     "feat_num_bins", "packed_cols", "axis_name",
+                     "comm_mode", "num_shards"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -442,7 +443,9 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            unpack_lanes=None,
                            forced=None, cegb=None,
                            packed_cols: int = 0,
-                           axis_name: str = "") -> TreeArrays:
+                           axis_name: str = "",
+                           comm_mode: str = "psum",
+                           num_shards: int = 1) -> TreeArrays:
     """Leaf-wise growth with per-leaf physical row partitions.
 
     The TPU counterpart of the reference's ``DataPartition``
@@ -539,7 +542,43 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return hf.at[:, 0, 0].set(sg - rest[:, 0]).at[:, 1, 0].set(
             sh - rest[:, 1])
 
+    # reduce-scatter comm mode (the reference DataParallelTreeLearner
+    # structure, data_parallel_tree_learner.cpp:149-240): per-split ICI
+    # volume is F*B/d per shard instead of d copies of the full block, each
+    # shard stores/scans only the global histograms of its own F/d features,
+    # and the winning split is an allreduce-argmax (SyncUpGlobalBestSplit,
+    # parallel_tree_learner.h:190-213)
+    rs = bool(axis_name) and comm_mode == "rs"
+    if rs:
+        assert unpack_lanes is None and forced is None and cegb is None, \
+            "comm_mode='rs' shards the feature scan; EFB unpacking, forced " \
+            "splits and CEGB need the full histogram block"
+        assert f % num_shards == 0, "pad features to a multiple of the mesh"
+        chunk_f = f // num_shards
+        off_f = jax.lax.axis_index(axis_name) * chunk_f
+
+        def _slc(a):
+            return jax.lax.dynamic_slice_in_dim(a, off_f, chunk_f, axis=0)
+        feat_c = FeatureInfo(*[None if a is None else _slc(a) for a in feat])
+        mask_c = _slc(feature_mask)
+        ids_c = off_f + jnp.arange(chunk_f, dtype=jnp.int32)
+
+    def reduce_hist(h):
+        if not axis_name:
+            return h
+        if rs:
+            return jax.lax.psum_scatter(h, axis_name, scatter_dimension=0,
+                                        tiled=True)
+        return jax.lax.psum(h, axis_name)
+
     def best_of(h, sg, sh, cnt, cmn, cmx, used=None):
+        if rs:
+            fb = per_feature_best_combined(
+                h, feat_c, mask_c, sg, sh, cnt, params,
+                any_categorical=has_categorical,
+                cmin=cmn if has_monotone else None,
+                cmax=cmx if has_monotone else None)
+            return sync_best(reduce_feature_best(fb, ids_c), axis_name)
         fb = per_feature_best_combined(
             unpack(h, sg, sh), feat, feature_mask, sg, sh, cnt, params,
             any_categorical=has_categorical,
@@ -648,9 +687,9 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     sum_g = jnp.sum(grad)
     sum_h = jnp.sum(hess)
     if axis_name:
-        # root aggregate + histogram Allreduce
+        # root aggregate + histogram Allreduce/ReduceScatter
         # (data_parallel_tree_learner.cpp:99-146)
-        hist0 = jax.lax.psum(hist0, axis_name)
+        hist0 = reduce_hist(hist0)
         sum_g = jax.lax.psum(sum_g, axis_name)
         sum_h = jax.lax.psum(sum_h, axis_name)
     no_min = jnp.float32(-np.inf)
@@ -723,10 +762,10 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             b.feature, b.threshold, b.default_left,
             feat.is_categorical[b.feature], b.cat_bitset, left_smaller)
         if axis_name:
-            # per-split histogram Allreduce of the smaller child
-            # (the reference's ReduceScatter at
-            # data_parallel_tree_learner.cpp:161, as psum)
-            hist_small = jax.lax.psum(hist_small, axis_name)
+            # per-split Allreduce (psum) or ReduceScatter (rs) of the
+            # smaller child's histogram
+            # (data_parallel_tree_learner.cpp:161 ReduceScatter)
+            hist_small = reduce_hist(hist_small)
 
         def sel(new, old):
             """Masked state write: keep ``old`` on dead iterations."""

@@ -100,7 +100,7 @@ def test_factory_single_device_falls_back_to_serial(problem):
 
 def test_factory_names(problem):
     ds, _, _ = problem
-    for name, cls in [("data", PartitionedDataParallelTreeLearner),
+    for name, cls in [("data", DataParallelTreeLearner),
                       ("feature", FeatureParallelTreeLearner),
                       ("voting", VotingParallelTreeLearner)]:
         learner = create_tree_learner(ds, Config(tree_learner=name))
@@ -121,7 +121,7 @@ def test_gbdt_indivisible_rows_and_few_features():
     cfg = Config(objective="regression", tree_learner="data", num_leaves=7,
                  num_iterations=3, bagging_fraction=0.8, bagging_freq=1)
     booster = GBDT(cfg, ds, create_objective("regression", cfg))
-    assert type(booster.learner) is PartitionedDataParallelTreeLearner
+    assert type(booster.learner) is DataParallelTreeLearner
     for _ in range(3):
         booster.train_one_iter()
     assert booster.num_trees == 3

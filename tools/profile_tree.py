@@ -1,109 +1,121 @@
-"""Decompose build_tree cost: t(tree) = L*(a*N + b) + c.
+"""On-device profiling of the tree build / fused training chunk.
 
-Times whole build_tree calls on the bench shapes at a small (N, L) grid, plus
-a chained histogram-only loop, so we can tell per-split fixed overhead from
-per-row streaming cost.  All timing is wall-clock around a device_get of a
-scalar from the result (the axon tunnel's block_until_ready is unreliable;
-scalar fetch forces completion and costs one round trip, measured first).
+The counterpart of the reference's ``Timer``/``FunctionTimer`` aggregation
+(include/LightGBM/utils/common.h:1032-1093) for DEVICE time: host-side timers
+only see dispatch on an async runtime (the axon tunnel's block_until_ready is
+unreliable), so this captures a ``jax.profiler`` trace and aggregates the XLA
+op durations from the xplane protobuf directly (the
+tensorboard_plugin_profile converter is broken against the installed
+TF/protobuf pair).
+
+Usage:
+    python tools/profile_tree.py [rows] [leaves] [max_bin]   # tree build
+    python tools/profile_tree.py --chunk [rows] [leaves]     # fused chunk
+
+Writes the trace under /tmp/lgbm_tpu_prof and prints the top ops by total
+device time, grouped by op name with counts — the numbers recorded in
+PERF.md.
 """
+import collections
+import glob
 import os
+import re
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from lightgbm_tpu.config import Config
-from lightgbm_tpu.core.tree_learner import SerialTreeLearner
-from lightgbm_tpu.core.histogram import histogram_pallas
-from lightgbm_tpu.io.dataset import BinnedDataset
-from lightgbm_tpu.utils.log import Log
 
-Log.reset_level(Log.level_from_verbosity(-1))
-F = 28
-MAXBIN = 63
+def aggregate_xplane(trace_dir: str, top: int = 25):
+    """[(name, total_ms, count)] by device time from the newest xplane.pb."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = sorted(glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True),
+                   key=os.path.getmtime)
+    if not paths:
+        raise SystemExit("no xplane.pb under %s — did the profiler run?"
+                         % trace_dir)
+    xs = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as fh:
+        xs.ParseFromString(fh.read())
+    plane = next((p for p in xs.planes if "TPU" in p.name), None)
+    if plane is None:
+        raise SystemExit("no TPU device plane in the trace (planes: %s) — "
+                         "this tool needs a TPU backend"
+                         % [p.name for p in xs.planes])
+    ev_meta = plane.event_metadata
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    for line in plane.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            key = re.sub(r"[.\d]+$", "", ev_meta[ev.metadata_id].name)
+            agg[key] += ev.duration_ps
+            cnt[key] += 1
+    return [(name, t / 1e9, cnt[name]) for name, t in agg.most_common(top)]
 
 
-def fetch(x):
-    return float(jax.device_get(jnp.ravel(x)[0]))
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.utils.log import Log
 
+    Log.reset_level(30)
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    chunk = "--chunk" in sys.argv
+    n = int(args[0]) if args else 1_000_000
+    leaves = int(args[1]) if len(args) > 1 else 255
+    max_bin = int(args[2]) if len(args) > 2 else 63
 
-def latency():
-    f = jax.jit(lambda x: x + 1.0)
-    fetch(f(jnp.float32(0)))
-    t0 = time.perf_counter()
-    for _ in range(5):
-        fetch(f(jnp.float32(0)))
-    return (time.perf_counter() - t0) / 5
-
-
-LAT = latency()
-print(f"tunnel latency ~{LAT*1e3:.1f} ms", flush=True)
-
-
-def make_data(n):
     rng = np.random.RandomState(0)
-    X = rng.normal(size=(n, F)).astype(np.float32)
-    y = (X[:, 0] + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
-    return BinnedDataset.from_matrix(X, label=y, max_bin=MAXBIN)
+    X = rng.normal(size=(n, 28)).astype(np.float32)
+    y = ((X[:, 0] * 2 + X[:, 1] ** 2 - X[:, 2] * X[:, 3]) > 0).astype(np.float64)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=max_bin)
+    cfg = Config(objective="binary", num_leaves=leaves, max_bin=max_bin,
+                 num_iterations=100)
+    trace_dir = "/tmp/lgbm_tpu_prof"
+
+    if chunk:
+        from lightgbm_tpu.boosting.gbdt import GBDT
+        from lightgbm_tpu.objective import create_objective
+        b = GBDT(cfg, ds, create_objective("binary", cfg))
+
+        def sync():
+            b.train_score.block_until_ready()
+            float(jax.device_get(b.train_score[0, 0]))
+
+        b.train_chunk(3)
+        sync()
+        t0 = time.perf_counter()
+        b.train_chunk(3)
+        sync()
+        print("fused chunk: %.1f ms/iter" % ((time.perf_counter() - t0) / 3 * 1e3))
+        with jax.profiler.trace(trace_dir):
+            b.train_chunk(3)
+            sync()
+    else:
+        from lightgbm_tpu.core.tree_learner import SerialTreeLearner
+        lrn = SerialTreeLearner(ds, cfg)
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        h = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+        arr = lrn.train(g, h, n)
+        int(arr.num_leaves)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            arr = lrn.train(g, h, n)
+        int(arr.num_leaves)
+        print("tree build: %.1f ms" % ((time.perf_counter() - t0) / 3 * 1e3))
+        with jax.profiler.trace(trace_dir):
+            arr = lrn.train(g, h, n)
+            int(arr.num_leaves)
+
+    for name, ms, c in aggregate_xplane(trace_dir):
+        print("%-88s %9.3f ms x%5d" % (name[:86], ms, c))
 
 
-def time_tree(learner, grad, hess, n, reps=3):
-    out = learner.train(grad, hess, n)
-    fetch(out.leaf_value)  # compile + warmup
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = learner.train(grad, hess, n)
-    fetch(out.leaf_value)
-    return (time.perf_counter() - t0 - LAT) / reps
-
-
-results = {}
-for n in (250_000, 1_000_000):
-    ds = make_data(n)
-    rng = np.random.RandomState(1)
-    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
-    hess = jnp.asarray(np.abs(rng.normal(size=n)).astype(np.float32) + 0.1)
-    for L in (31, 255):
-        cfg = Config(objective="binary", num_leaves=L, max_bin=MAXBIN)
-        learner = SerialTreeLearner(ds, cfg)
-        t = time_tree(learner, grad, hess, n)
-        results[(n, L)] = t
-        print(f"build_tree N={n:>9,} L={L:>3}: {t*1e3:8.1f} ms "
-              f"({t/(L-1)*1e3:6.2f} ms/split)", flush=True)
-
-# fixed-vs-variable decomposition
-a = ((results[(1_000_000, 255)] - results[(250_000, 255)]) / 254
-     - (results[(1_000_000, 31)] - results[(250_000, 31)]) / 30) / 750_000
-print(f"per-split per-row cost ~{a*1e9:.2f} ns/row; "
-      f"per-split avg @1M/255 ~{(results[(1_000_000,255)]/254)*1e3:.2f} ms")
-
-# chained histogram-only loop at 1M rows
-n = 1_000_000
-pad = (-n) % 1024
-rng = np.random.RandomState(0)
-bins = jnp.asarray(rng.randint(0, MAXBIN, size=(n + pad, F), dtype=np.uint8))
-vals = jnp.asarray(rng.normal(size=(n + pad, 2)).astype(np.float32))
-REPS = 50
-
-
-@jax.jit
-def hist_chain(v):
-    def body(i, s):
-        v, acc = s
-        h = histogram_pallas(bins, v, 128, row_tile=1024)
-        return v + h[0, 0, 0] * 1e-30, acc + h[0, 0, 0]
-    return jax.lax.fori_loop(0, REPS, body, (v, jnp.float32(0)))
-
-
-out = hist_chain(vals)
-fetch(out[1])
-t0 = time.perf_counter()
-out = hist_chain(vals)
-fetch(out[1])
-t = (time.perf_counter() - t0 - LAT) / REPS
-print(f"histogram_pallas 1M rows (chained x{REPS}): {t*1e3:.2f} ms/pass "
-      f"= {n/t/1e6:.0f} Mrows/s", flush=True)
+if __name__ == "__main__":
+    main()
