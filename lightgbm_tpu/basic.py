@@ -82,10 +82,44 @@ def _data_from_pandas(data, feature_name, categorical_feature):
     return out, feature_name, categorical
 
 
+class CSRData:
+    """Sparse input as raw CSR arrays — stays sparse through binning
+    (BinnedDataset.from_csr); scipy is not required."""
+
+    def __init__(self, indptr, indices, values, num_col: int) -> None:
+        self.indptr = np.asarray(indptr, dtype=np.int64)
+        self.indices = np.asarray(indices, dtype=np.int64)
+        self.values = np.asarray(values, dtype=np.float64)
+        self.num_col = int(num_col)
+
+    @property
+    def shape(self):
+        return (len(self.indptr) - 1, self.num_col)
+
+
+def _as_csr(data) -> "Optional[CSRData]":
+    """CSRData / scipy-sparse -> CSRData (sparse path); else None."""
+    if isinstance(data, CSRData):
+        return data
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(data):
+            m = data.tocsr()
+            return CSRData(m.indptr, m.indices, m.data, m.shape[1])
+    except ImportError:
+        pass
+    return None
+
+
 def _to_matrix(data, feature_name="auto", categorical_feature="auto"):
     """Accept numpy/pandas/list/scipy-sparse; return dense float64 matrix."""
     if PANDAS_INSTALLED and isinstance(data, DataFrame):
         return _data_from_pandas(data, feature_name, categorical_feature)
+    if isinstance(data, CSRData):
+        mat = np.zeros(data.shape, dtype=np.float64)
+        rows = np.repeat(np.arange(data.shape[0]), np.diff(data.indptr))
+        mat[rows, data.indices] = data.values
+        data = mat
     try:
         import scipy.sparse as sps
         if sps.issparse(data):
@@ -136,8 +170,6 @@ class Dataset:
                 self.handle.metadata.set_label(
                     _list_to_1d_numpy(self.label, np.float64, "label"))
             return self
-        mat, names, cats = _to_matrix(self.data, self.feature_name,
-                                      self.categorical_feature)
         cfg = Config(alias_transform(dict(self.params)))
         label = _list_to_1d_numpy(self.label, np.float64, "label")
         weight = _list_to_1d_numpy(self.weight, np.float64, "weight")
@@ -147,6 +179,31 @@ class Dataset:
         if self.reference is not None:
             self.reference.construct()
             ref_handle = self.reference.handle
+        csr = _as_csr(self.data)
+        if csr is not None and self.categorical_feature in ("auto", None):
+            # sparse path: bin straight from CSR, never densify
+            # (sparse_bin.hpp counterpart)
+            self.handle = BinnedDataset.from_csr(
+                csr.indptr, csr.indices, csr.values, csr.num_col,
+                label=label, weight=weight, group=group,
+                init_score=init_score, max_bin=int(cfg.max_bin),
+                min_data_in_bin=int(cfg.min_data_in_bin),
+                min_data_in_leaf=int(cfg.min_data_in_leaf),
+                bin_construct_sample_cnt=int(cfg.bin_construct_sample_cnt),
+                use_missing=bool(cfg.use_missing),
+                zero_as_missing=bool(cfg.zero_as_missing),
+                data_random_seed=int(cfg.data_random_seed),
+                enable_bundle=bool(cfg.enable_bundle),
+                feature_names=(None if self.feature_name == "auto"
+                               else list(self.feature_name)),
+                max_bin_by_feature=(list(cfg.max_bin_by_feature)
+                                    if cfg.max_bin_by_feature else None),
+                reference=ref_handle)
+            if self.free_raw_data:
+                self.data = None
+            return self
+        mat, names, cats = _to_matrix(self.data, self.feature_name,
+                                      self.categorical_feature)
         self.handle = BinnedDataset.from_matrix(
             mat, label=label, weight=weight, group=group, init_score=init_score,
             max_bin=int(cfg.max_bin), min_data_in_bin=int(cfg.min_data_in_bin),

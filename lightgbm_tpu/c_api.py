@@ -174,6 +174,27 @@ def _csr_to_dense(indptr, indices, data, num_col) -> np.ndarray:
     return mat
 
 
+def _csc_to_csr(col_ptr, indices, data, num_row):
+    """CSC arrays -> CSR arrays in O(nnz)."""
+    col_of = np.repeat(np.arange(len(col_ptr) - 1, dtype=np.int64),
+                       np.diff(np.asarray(col_ptr, dtype=np.int64)))
+    rows = np.asarray(indices, dtype=np.int64)
+    order = np.argsort(rows, kind="stable")
+    indptr = np.searchsorted(rows[order], np.arange(num_row + 1))
+    return indptr, col_of[order], np.asarray(data, dtype=np.float64)[order]
+
+
+def _impl_dataset_create_from_csr(indptr, indices, values, num_col: int,
+                                  parameters: str, ref: Optional[int]) -> int:
+    from .basic import CSRData
+    params = _parse_params(parameters)
+    ref_ds = _get(ref).ds if ref else None
+    ds = Dataset(CSRData(indptr, indices, values, num_col), params=params,
+                 reference=ref_ds)
+    ds.construct()
+    return _new_handle(_CDataset(ds))
+
+
 def _csc_to_dense(col_ptr, indices, data, num_row) -> np.ndarray:
     ncol = len(col_ptr) - 1
     mat = np.zeros((int(num_row), ncol), dtype=np.float64)
@@ -350,9 +371,9 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
         ip = _typed(indptr, nindptr, indptr_type)
         idx = _nparr(indices, nelem, np.int32)
         vals = _typed(data, nelem, data_type)
-        mat = _csr_to_dense(ip, idx, vals, num_col)
-        out[0] = ffi.cast("void*", _impl_dataset_create_from_mat(
-            mat, _str(parameters), _opt_handle(reference)))
+        out[0] = ffi.cast("void*", _impl_dataset_create_from_csr(
+            ip, idx, vals, int(num_col), _str(parameters),
+            _opt_handle(reference)))
 
     @export("LGBM_DatasetCreateFromCSRFunc")
     def _(get_row_funptr, num_rows, num_col, parameters, reference, out):
@@ -365,9 +386,10 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
         cp = _typed(col_ptr, ncol_ptr, col_ptr_type)
         idx = _nparr(indices, nelem, np.int32)
         vals = _typed(data, nelem, data_type)
-        mat = _csc_to_dense(cp, idx, vals, num_row)
-        out[0] = ffi.cast("void*", _impl_dataset_create_from_mat(
-            mat, _str(parameters), _opt_handle(reference)))
+        ip, ridx, rvals = _csc_to_csr(cp, idx, vals, int(num_row))
+        out[0] = ffi.cast("void*", _impl_dataset_create_from_csr(
+            ip, ridx, rvals, len(cp) - 1, _str(parameters),
+            _opt_handle(reference)))
 
     @export("LGBM_DatasetCreateFromMat")
     def _(data, data_type, nrow, ncol, is_row_major, parameters, reference,

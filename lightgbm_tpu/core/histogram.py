@@ -395,16 +395,18 @@ def histogram_xla_masked(bins: jax.Array, values: jax.Array, num_bins: int,
 
 
 def partition_buckets(n: int, row_tile: int = 2048) -> tuple:
-    """Static window-slice sizes (rows): powers of 2 × row_tile, plus n.
+    """Static window-slice sizes (rows): geometric in row_tile, plus n.
 
     Per-split partition/histogram cost scales with the BUCKET covering the
     window, so tighter spacing buys back the slack (2x spacing: <=2x the
-    window; 4x spacing averaged ~2.5x) at the price of a few more compiled
-    switch branches."""
+    window; 4x spacing averaged ~2.5x) at the price of more compiled switch
+    branches.  Small datasets (tests, CPU) use 4x spacing — there the cost is
+    compile time, not slack."""
+    spacing = 2 if n > (1 << 17) else 4
     sizes = []
     b = row_tile
     while b < n:
         sizes.append(b)
-        b *= 2
+        b *= spacing
     sizes.append(n)
     return tuple(sizes)

@@ -131,7 +131,8 @@ class BinnedDataset:
             self.bin_offset = reference.bin_offset
             self.num_bin_per_group = list(reference.num_bin_per_group)
         else:
-            self.feature_groups = (self._find_groups(cols) if enable_bundle
+            self.feature_groups = (self._find_groups_from_cols(cols)
+                                   if enable_bundle
                                    else [[j] for j in range(len(cols))])
             self._assign_group_layout()
         self.binned = self._bundle_columns(cols)
@@ -139,18 +140,150 @@ class BinnedDataset:
             self.raw_data = data
         return self
 
+    @classmethod
+    def from_csr(cls, indptr, indices, values, num_col: int, label=None,
+                 weight=None, group=None, init_score=None, max_bin: int = 255,
+                 min_data_in_bin: int = 3, min_data_in_leaf: int = 20,
+                 bin_construct_sample_cnt: int = 200000,
+                 use_missing: bool = True, zero_as_missing: bool = False,
+                 data_random_seed: int = 1,
+                 feature_names: Optional[Sequence[str]] = None,
+                 max_bin_by_feature: Optional[Sequence[int]] = None,
+                 enable_bundle: bool = True,
+                 reference: Optional["BinnedDataset"] = None
+                 ) -> "BinnedDataset":
+        """Construct from CSR sparse input WITHOUT densifying.
+
+        The counterpart of the reference's sparse path (src/io/
+        sparse_bin.hpp, multi_val_sparse_bin.hpp): per-feature nonzero values
+        feed bin finding (zeros implied by the total count,
+        dataset_loader.cpp:819 contract) and the bin codes scatter straight
+        into the EFB-bundled group columns.  Peak host memory is O(nnz) plus
+        the bundled [N, num_groups] output; a dense [N, F] float matrix never
+        exists.  Numerical features only; ``raw_data`` is not kept (refit and
+        raw-value prediction paths need dense input)."""
+        indptr = np.asarray(indptr, dtype=np.int64)
+        col_idx = np.asarray(indices, dtype=np.int64)
+        vals = np.asarray(values, dtype=np.float64)
+        self = cls()
+        self.num_data = n = int(len(indptr) - 1)
+        self.num_total_features = f_total = int(num_col)
+        self.metadata = Metadata(n)
+        if label is not None:
+            self.metadata.set_label(label)
+        if weight is not None:
+            self.metadata.set_weights(weight)
+        if group is not None:
+            self.metadata.set_group(group)
+        if init_score is not None:
+            self.metadata.set_init_score(init_score)
+        self.feature_names = (list(feature_names) if feature_names is not None
+                              else ["Column_%d" % i for i in range(f_total)])
+
+        # CSR -> CSC in O(nnz): per-nonzero row ids, stably sorted by column
+        row_of = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+        order = np.argsort(col_idx, kind="stable")
+        col_sorted = col_idx[order]
+        rows_by_col = row_of[order]
+        vals_by_col = vals[order]
+        col_start = np.searchsorted(col_sorted, np.arange(f_total + 1))
+
+        rng = np.random.RandomState(data_random_seed)
+        if n > bin_construct_sample_cnt:
+            sample_idx = np.sort(rng.choice(n, size=bin_construct_sample_cnt,
+                                            replace=False))
+        else:
+            sample_idx = np.arange(n)
+        total = len(sample_idx)
+        in_sample = np.zeros(n, dtype=bool)
+        in_sample[sample_idx] = True
+
+        if reference is not None:
+            if reference.num_total_features != f_total:
+                Log.fatal("Validation data has %d features, train data has %d",
+                          f_total, reference.num_total_features)
+            self.bin_mappers = reference.bin_mappers
+            self.feature_names = reference.feature_names
+        else:
+            self.bin_mappers = []
+            for f in range(f_total):
+                s, e = col_start[f], col_start[f + 1]
+                v = vals_by_col[s:e]
+                v = v[in_sample[rows_by_col[s:e]]]
+                v = v[(v != 0.0) | np.isnan(v)]
+                m = BinMapper()
+                fmax = (int(max_bin_by_feature[f]) if max_bin_by_feature
+                        else int(max_bin))
+                m.find_bin(v, total, fmax, min_data_in_bin,
+                           min_split_data=min_data_in_leaf,
+                           bin_type=BinType.NUMERICAL,
+                           use_missing=use_missing,
+                           zero_as_missing=zero_as_missing)
+                self.bin_mappers.append(m)
+
+        self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
+                                 if not m.is_trivial]
+        self.inner_feature_map = {f: j for j, f in
+                                  enumerate(self.used_feature_idx)}
+        self.num_bin_per_feature = [self.bin_mappers[i].num_bin
+                                    for i in self.used_feature_idx]
+
+        # per-used-feature sparse codes (nonzero positions only)
+        rows_f: List[np.ndarray] = []
+        codes_f: List[np.ndarray] = []
+        zero_bin: List[int] = []
+        for j, i in enumerate(self.used_feature_idx):
+            s, e = col_start[i], col_start[i + 1]
+            m = self.bin_mappers[i]
+            rows_f.append(rows_by_col[s:e])
+            codes_f.append(m.values_to_bins(vals_by_col[s:e]).astype(np.int32))
+            zero_bin.append(int(m.values_to_bins(np.zeros(1))[0]))
+
+        if reference is not None:
+            self.feature_groups = [list(g) for g in reference.feature_groups]
+            self.group_idx = reference.group_idx
+            self.bin_offset = reference.bin_offset
+            self.num_bin_per_group = list(reference.num_bin_per_group)
+        elif enable_bundle:
+            # sampled active bitmaps (code != 0) straight from the sparse codes
+            samp_pos = np.full(n, -1, dtype=np.int64)
+            eff = min(total, self._EFB_SAMPLE)
+            samp_pos[sample_idx[:eff]] = np.arange(eff)
+            active = []
+            for j in range(len(self.used_feature_idx)):
+                a = np.zeros(eff, dtype=bool)
+                pos = samp_pos[rows_f[j][codes_f[j] != 0]]
+                a[pos[pos >= 0]] = True
+                active.append(a)
+            self.feature_groups = self._find_groups(active)
+            self._assign_group_layout()
+        else:
+            self.feature_groups = [[j] for j in
+                                   range(len(self.used_feature_idx))]
+            self._assign_group_layout()
+        max_nb = max(self.num_bin_per_group, default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        out = np.zeros((n, len(self.feature_groups)), dtype=dtype)
+        for g, feats in enumerate(self.feature_groups):
+            if len(feats) == 1:
+                j = feats[0]
+                if zero_bin[j]:
+                    out[:, g] = dtype(zero_bin[j])
+                out[rows_f[j], g] = codes_f[j].astype(dtype)
+            else:
+                for j in feats:  # push order: later features win conflicts
+                    nz = codes_f[j] != 0
+                    out[rows_f[j][nz], g] = (self.bin_offset[j]
+                                             + codes_f[j][nz] - 1).astype(dtype)
+        self.binned = out
+        self.raw_data = None
+        return self
+
     # ---- EFB bundling (dataset.cpp:92-290) ----
 
     _EFB_SAMPLE = 65536
 
-    def _find_groups(self, cols: List[np.ndarray]) -> List[List[int]]:
-        """Greedy mutually-exclusive feature grouping (FindGroups,
-        dataset.cpp:92-215): a feature joins the first group whose conflict
-        count stays within the budget (total/10000, :104) and at most half the
-        feature's active rows (:143); group bin budget 256 (:103).  Tried in
-        both natural and active-count order, keeping the fewer groups
-        (FastFeatureBundling :215-290).  Only features whose default bin is 0
-        share the group's 0 code; others stay singletons."""
+    def _find_groups_from_cols(self, cols: List[np.ndarray]) -> List[List[int]]:
         nf = len(cols)
         if nf <= 1:
             return [[j] for j in range(nf)]
@@ -161,6 +294,20 @@ class BinnedDataset:
         else:
             rows = slice(None)
         active = [np.asarray(c[rows] != 0) for c in cols]
+        return self._find_groups(active)
+
+    def _find_groups(self, active: List[np.ndarray]) -> List[List[int]]:
+        """Greedy mutually-exclusive feature grouping (FindGroups,
+        dataset.cpp:92-215) over per-feature active-row bitmaps (sampled): a
+        feature joins the first group whose conflict count stays within the
+        budget (total/10000, :104) and at most half the feature's active rows
+        (:143); group bin budget 256 (:103).  Tried in both natural and
+        active-count order, keeping the fewer groups (FastFeatureBundling
+        :215-290).  Only features whose default bin is 0 share the group's 0
+        code; others stay singletons."""
+        nf = len(active)
+        if nf <= 1:
+            return [[j] for j in range(nf)]
         counts = [int(a.sum()) for a in active]
         total = active[0].shape[0] if nf else 0
         budget = total // 10000
@@ -218,23 +365,37 @@ class BinnedDataset:
                 off += self.num_bin_per_feature[j] - 1
             self.num_bin_per_group.append(off)
 
-    def _bundle_columns(self, cols: List[np.ndarray]) -> np.ndarray:
+    def _bundle_columns(self, cols: List[np.ndarray],
+                        num_rows: Optional[int] = None) -> np.ndarray:
         max_nb = max(self.num_bin_per_group, default=2)
         dtype = np.uint8 if max_nb <= 256 else np.uint16
         if not cols:
-            return np.zeros((self.num_data, 0), dtype=dtype)
-        out = np.zeros((self.num_data, len(self.feature_groups)), dtype=dtype)
+            return np.zeros((num_rows if num_rows is not None
+                             else self.num_data, 0), dtype=dtype)
+        n = len(cols[0])
+        out = np.zeros((n, len(self.feature_groups)), dtype=dtype)
         for g, feats in enumerate(self.feature_groups):
             if len(feats) == 1:
                 out[:, g] = cols[feats[0]].astype(dtype)
                 continue
-            gcol = np.zeros(self.num_data, dtype=np.int32)
+            gcol = np.zeros(n, dtype=np.int32)
             for j in feats:   # push order: later features win conflicts
                 b = cols[j]
                 nz = b != 0
                 gcol[nz] = self.bin_offset[j] + b[nz] - 1
             out[:, g] = gcol.astype(dtype)
         return out
+
+    def bundle_rows(self, feats_chunk: np.ndarray) -> np.ndarray:
+        """Bin + bundle a [m, F_total] raw-value chunk using this dataset's
+        mappers and group layout (the two_round loader's second pass:
+        dataset_loader.cpp two_round re-read straight into storage)."""
+        col_dtype = (np.uint8 if max(self.num_bin_per_feature, default=2) <= 256
+                     else np.uint16)
+        cols = [self.bin_mappers[i].values_to_bins(
+                    feats_chunk[:, i]).astype(col_dtype)
+                for i in self.used_feature_idx]
+        return self._bundle_columns(cols, num_rows=len(feats_chunk))
 
     @property
     def is_bundled(self) -> bool:

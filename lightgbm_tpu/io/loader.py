@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .dataset import BinnedDataset
+from .metadata import Metadata
 from .parser import detect_format, parse_file
 from ..utils.log import Log
 
@@ -127,6 +128,70 @@ def _default_allgather(num_machines: int):
     return gather
 
 
+class _Columns:
+    """Resolved column layout in FULL-file coordinates."""
+
+    def __init__(self, label_idx, weight_idx, group_idx, ignore, keep,
+                 categorical):
+        self.label_idx = label_idx
+        self.weight_idx = weight_idx
+        self.group_idx = group_idx
+        self.ignore = ignore
+        self.keep = keep                # kept feature columns (full coords)
+        self.categorical = categorical  # positions within ``keep``
+
+
+def _resolve_columns(cfg, names, full_cols: int,
+                     is_libsvm: bool) -> _Columns:
+    """Resolve label/weight/group/ignore/categorical specs.
+
+    The label spec indexes the FULL file; every other spec is in
+    LABEL-EXCLUDED coordinates — the reference parser renumbers columns after
+    erasing the label (dataset_loader.cpp:31-130 SetHeader builds name2idx
+    after the erase; parser.hpp applies offset -1 past the label).  For
+    LibSVM the leading target is the label and positional specs don't apply
+    (parser.hpp LibSVM branch)."""
+    if is_libsvm:
+        for spec, nm in ((cfg.label_column, "label_column"),
+                         (cfg.weight_column, "weight_column"),
+                         (cfg.group_column, "group_column"),
+                         (cfg.ignore_column, "ignore_column")):
+            if str(spec or ""):
+                Log.warning("%s is not supported for LibSVM files and will "
+                            "be ignored (use the .weight/.query side files)",
+                            nm)
+        return _Columns(0, -1, -1, set(), list(range(1, full_cols)), [])
+    label_idx = _parse_column_spec(str(cfg.label_column) or "0", names,
+                                   "label")
+    if label_idx < 0:
+        label_idx = 0
+    names_nolabel = (None if names is None else
+                     names[:label_idx] + names[label_idx + 1:])
+
+    def to_full(idx: int) -> int:
+        return idx if idx < label_idx else idx + 1
+
+    weight_idx = _parse_column_spec(str(cfg.weight_column), names_nolabel,
+                                    "weight")
+    group_idx = _parse_column_spec(str(cfg.group_column), names_nolabel,
+                                   "group")
+    weight_idx = to_full(weight_idx) if weight_idx >= 0 else -1
+    group_idx = to_full(group_idx) if group_idx >= 0 else -1
+    ignore = {to_full(i) for i in
+              _parse_multi_column_spec(cfg.ignore_column, names_nolabel)}
+    drop = {label_idx} | ignore
+    if weight_idx >= 0:
+        drop.add(weight_idx)
+    if group_idx >= 0:
+        drop.add(group_idx)
+    keep = [i for i in range(full_cols) if i not in drop]
+    cat_cols = {to_full(i) for i in _parse_multi_column_spec(
+        cfg.categorical_feature, names_nolabel)}
+    categorical = [j for j, i in enumerate(keep) if i in cat_cols]
+    return _Columns(label_idx, weight_idx, group_idx, ignore, keep,
+                    categorical)
+
+
 class DatasetLoader:
     """Config-driven text/binary loading (include/LightGBM/dataset_loader.h)."""
 
@@ -143,71 +208,24 @@ class DatasetLoader:
         if _is_binary_file(filename):
             ds = BinnedDataset.load_binary(filename)
             return ds
+        if bool(cfg.two_round):
+            return self._load_two_round(filename, rank, num_machines,
+                                        reference)
         header = bool(cfg.header) if cfg.header else None
-        # The label spec is an index into the FULL file; every other column spec
-        # (weight/group/ignore/categorical) is in LABEL-EXCLUDED coordinates —
-        # the reference parser renumbers columns after erasing the label
-        # (dataset_loader.cpp:31-130 SetHeader builds name2idx after the erase;
-        # parser.hpp applies offset -1 past the label).
         is_libsvm = detect_format(filename)[0] == "libsvm"
         if is_libsvm:
-            # LibSVM's leading target IS the label; there are no positional
-            # weight/group/ignore columns to resolve (parser.hpp LibSVM branch)
-            for spec, nm in ((cfg.label_column, "label_column"),
-                             (cfg.weight_column, "weight_column"),
-                             (cfg.group_column, "group_column"),
-                             (cfg.ignore_column, "ignore_column")):
-                if str(spec or ""):
-                    Log.warning("%s is not supported for LibSVM files and "
-                                "will be ignored (use the .weight/.query "
-                                "side files)", nm)
             mat, label, names = parse_file(filename, header=header,
                                            label_idx=0)
-            weight = group_col = None
-            names_nolabel = None
-            keep = list(range(mat.shape[1]))
-            feat_names = None
-
-            def to_full(idx: int) -> int:
-                return idx
+            full = np.concatenate([label[:, None], mat], axis=1)
         else:
-            feats, label, names = parse_file(filename, header=header,
-                                             label_idx=-1)
-            label_idx = _parse_column_spec(str(cfg.label_column) or "0", names,
-                                           "label")
-            if label_idx < 0:
-                label_idx = 0
-            names_nolabel = (None if names is None else
-                             names[:label_idx] + names[label_idx + 1:])
-
-            def to_full(idx: int) -> int:
-                """label-excluded column index -> full-file column index."""
-                return idx if idx < label_idx else idx + 1
-
-            weight_idx = _parse_column_spec(str(cfg.weight_column),
-                                            names_nolabel, "weight")
-            group_idx = _parse_column_spec(str(cfg.group_column),
-                                           names_nolabel, "group")
-            if weight_idx >= 0:
-                weight_idx = to_full(weight_idx)
-            if group_idx >= 0:
-                group_idx = to_full(group_idx)
-            ignore = {to_full(i) for i in
-                      _parse_multi_column_spec(cfg.ignore_column,
-                                               names_nolabel)}
-
-            label = feats[:, label_idx]
-            weight = feats[:, weight_idx] if weight_idx >= 0 else None
-            group_col = feats[:, group_idx] if group_idx >= 0 else None
-            drop = {label_idx} | ignore
-            if weight_idx >= 0:
-                drop.add(weight_idx)
-            if group_idx >= 0:
-                drop.add(group_idx)
-            keep = [i for i in range(feats.shape[1]) if i not in drop]
-            mat = feats[:, keep]
-            feat_names = ([names[i] for i in keep]
-                          if names is not None else None)
+            full, _, names = parse_file(filename, header=header, label_idx=-1)
+        cols = _resolve_columns(cfg, names, full.shape[1], is_libsvm)
+        label = full[:, cols.label_idx]
+        weight = full[:, cols.weight_idx] if cols.weight_idx >= 0 else None
+        group_col = full[:, cols.group_idx] if cols.group_idx >= 0 else None
+        mat = full[:, cols.keep]
+        feat_names = ([names[i] for i in cols.keep]
+                      if names is not None else None)
 
         # distributed loading: contiguous stripe per rank
         # (dataset_loader.cpp:168 pre_partition / sampled partitioning)
@@ -239,11 +257,7 @@ class DatasetLoader:
             init_score = np.loadtxt(init_file, dtype=np.float64, ndmin=1)
             Log.info("Reading initial scores from %s", init_file)
 
-        # categorical_feature specs are label-excluded column indices too
-        # (SetHeader resolves them against the label-erased name2idx)
-        cat_cols = {to_full(i) for i in _parse_multi_column_spec(
-            cfg.categorical_feature, names_nolabel)}
-        categorical = [j for j, i in enumerate(keep) if i in cat_cols]
+        categorical = cols.categorical
         forced_bins = None
         if getattr(cfg, "forcedbins_filename", ""):
             forced_bins = _load_forced_bins(cfg.forcedbins_filename)
@@ -282,6 +296,146 @@ class DatasetLoader:
             reference=reference, bin_mappers=mappers)
         if cfg.save_binary:
             ds.save_binary(filename + ".bin")
+        return ds
+
+    # ---- two_round / streaming loading ----
+    # dataset_loader.cpp two_round: pass 1 streams the file once, counting
+    # rows and reservoir-sampling values for bin finding; pass 2 re-reads the
+    # file in bounded chunks and bins each chunk straight into the bundled
+    # storage.  Peak memory is the sample + one chunk + the [N, G] binned
+    # matrix — the raw [N, F] float matrix never exists.
+
+    _TWO_ROUND_CHUNK = 65536
+
+    def _load_two_round(self, filename: str, rank: int = 0,
+                        num_machines: int = 1,
+                        reference: Optional[BinnedDataset] = None
+                        ) -> BinnedDataset:
+        from .parser import sample_stream, stream_file
+
+        cfg = self.config
+        header = bool(cfg.header) if cfg.header else None
+        fmt = detect_format(filename)[0]
+        sample, total_rows, full_cols = sample_stream(
+            filename, int(cfg.bin_construct_sample_cnt),
+            seed=int(cfg.data_random_seed), header=header,
+            chunk_rows=self._TWO_ROUND_CHUNK)
+        Log.info("two_round: sampled %d of %d rows from %s",
+                 len(sample), total_rows, filename)
+        if fmt == "libsvm":
+            full_cols += 1   # sample matrix carries the label at column 0
+
+        # column resolution (full-file coordinates; LibSVM fixes label at 0)
+        names = None
+        if fmt != "libsvm":
+            from .parser import sniff_header
+            has_hdr, hdr_names = sniff_header(filename)
+            if header is None:
+                header = has_hdr
+            if header:
+                names = hdr_names
+        cols = _resolve_columns(cfg, names, full_cols, fmt == "libsvm")
+        label_idx, weight_idx, group_idx = (cols.label_idx, cols.weight_idx,
+                                            cols.group_idx)
+        keep = cols.keep
+        feat_names = [names[i] for i in keep] if names is not None else None
+
+        # rank stripe (dataset_loader.cpp:168 pre_partition)
+        begin, end = 0, total_rows
+        if num_machines > 1 and cfg.pre_partition is False:
+            begin = total_rows * rank // num_machines
+            end = total_rows * (rank + 1) // num_machines
+        n_kept = end - begin
+
+        # schema (mappers + EFB groups) from the sample
+        if reference is not None:
+            schema = reference
+        else:
+            schema = BinnedDataset.from_matrix(
+                sample[:, keep] if len(sample) else
+                np.zeros((0, len(keep))),
+                max_bin=int(cfg.max_bin),
+                min_data_in_bin=int(cfg.min_data_in_bin),
+                min_data_in_leaf=int(cfg.min_data_in_leaf),
+                bin_construct_sample_cnt=len(sample) or 1,
+                categorical_feature=cols.categorical,
+                use_missing=bool(cfg.use_missing),
+                zero_as_missing=bool(cfg.zero_as_missing),
+                data_random_seed=int(cfg.data_random_seed),
+                enable_bundle=bool(cfg.enable_bundle),
+                feature_names=feat_names, keep_raw=False,
+                max_bin_by_feature=(list(cfg.max_bin_by_feature)
+                                    if cfg.max_bin_by_feature else None))
+
+        ds = BinnedDataset()
+        ds.num_data = n_kept
+        ds.num_total_features = len(keep)
+        ds.feature_names = (list(schema.feature_names)
+                            if schema.feature_names else feat_names)
+        ds.bin_mappers = schema.bin_mappers
+        ds.used_feature_idx = list(schema.used_feature_idx)
+        ds.inner_feature_map = dict(schema.inner_feature_map)
+        ds.num_bin_per_feature = list(schema.num_bin_per_feature)
+        ds.feature_groups = [list(g) for g in schema.feature_groups]
+        ds.group_idx = schema.group_idx
+        ds.bin_offset = schema.bin_offset
+        ds.num_bin_per_group = list(schema.num_bin_per_group)
+        ds.raw_data = None
+
+        max_nb = max(ds.num_bin_per_group, default=2)
+        out_dtype = np.uint8 if max_nb <= 256 else np.uint16
+        binned = np.zeros((n_kept, len(ds.feature_groups)), dtype=out_dtype)
+        label = np.zeros(n_kept, dtype=np.float64)
+        weight = (np.zeros(n_kept, dtype=np.float64)
+                  if weight_idx >= 0 else None)
+        group_col = (np.zeros(n_kept, dtype=np.float64)
+                     if group_idx >= 0 else None)
+
+        pos = 0       # global row cursor in the file
+        wpos = 0      # write cursor into the kept stripe
+        for chunk in stream_file(filename, self._TWO_ROUND_CHUNK, header,
+                                 num_cols=(full_cols - 1 if fmt == "libsvm"
+                                           else None)):
+            m = chunk.shape[0]
+            lo, hi = max(begin - pos, 0), min(end - pos, m)
+            pos += m
+            if hi <= lo:
+                continue
+            part = chunk[lo:hi]
+            k = part.shape[0]
+            binned[wpos:wpos + k] = ds.bundle_rows(part[:, keep])
+            label[wpos:wpos + k] = part[:, label_idx]
+            if weight is not None:
+                weight[wpos:wpos + k] = part[:, weight_idx]
+            if group_col is not None:
+                group_col[wpos:wpos + k] = part[:, group_idx]
+            wpos += k
+        assert wpos == n_kept, (wpos, n_kept)
+        ds.binned = binned
+
+        ds.metadata = Metadata(n_kept)
+        ds.metadata.set_label(label)
+        group = None
+        if group_col is not None:
+            _, counts = np.unique(group_col, return_counts=True)
+            group = counts.astype(np.int32)
+        weight_file = filename + ".weight"
+        if weight is None and os.path.exists(weight_file):
+            weight = np.loadtxt(weight_file, dtype=np.float64,
+                                ndmin=1)[begin:end]
+            Log.info("Reading weights from %s", weight_file)
+        query_file = filename + ".query"
+        if group is None and os.path.exists(query_file):
+            group = np.loadtxt(query_file, dtype=np.int32, ndmin=1)
+            Log.info("Reading query boundaries from %s", query_file)
+        init_file = filename + ".init"
+        if os.path.exists(init_file):
+            ds.metadata.set_init_score(
+                np.loadtxt(init_file, dtype=np.float64, ndmin=1)[begin:end])
+        if weight is not None:
+            ds.metadata.set_weights(weight)
+        if group is not None:
+            ds.metadata.set_group(group)
         return ds
 
     def load_prediction_data(self, filename: str):

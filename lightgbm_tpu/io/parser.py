@@ -139,3 +139,169 @@ def _parse_libsvm(path: str, label_idx: int
         for i, v in pairs:
             mat[r, i] = v
     return mat, np.asarray(labels), None
+
+
+# ---- streaming (two_round) readers --------------------------------------
+# Counterparts of the reference's sampling/streaming text pipeline
+# (src/io/dataset_loader.cpp:819 SampleTextDataFromFile + the two_round
+# re-read, utils/pipeline_reader.h): pass 1 reservoir-samples rows while
+# counting them; pass 2 re-reads the file in bounded chunks.
+
+
+_NA_TOKENS = {"", "NA", "N/A", "nan", "NaN", "null"}
+
+
+def sniff_header(path: str):
+    """(has_header, column names or None) using the same detection as
+    parse_file."""
+    fmt, sep = detect_format(path)
+    if fmt == "libsvm":
+        return False, None
+    first = _sniff_lines(path, 1)[0]
+    if not _has_header(first, sep):
+        return False, None
+    return True, [c.strip() for c in first.split(sep)]
+
+
+def stream_file(path: str, chunk_rows: int = 65536,
+                header: "Optional[bool]" = None,
+                num_cols: "Optional[int]" = None):
+    """Yield [m, D] float64 chunks of a text data file (m <= chunk_rows).
+
+    For CSV/TSV, D is the file's column count (label still embedded).  For
+    LibSVM, the leading label is column 0 and features occupy columns
+    1..num_cols (``num_cols`` from a prior sampling pass is required so
+    chunk widths agree)."""
+    fmt, sep = detect_format(path)
+    if fmt == "libsvm":
+        if num_cols is None:
+            raise ValueError("LibSVM streaming needs num_cols from the "
+                             "sampling pass")
+        buf_rows: List[List[Tuple[int, float]]] = []
+        labels: List[float] = []
+
+        def flush():
+            mat = np.zeros((len(buf_rows), num_cols + 1), dtype=np.float64)
+            mat[:, 0] = labels
+            for r, pairs in enumerate(buf_rows):
+                for i, v in pairs:
+                    if i < num_cols:
+                        mat[r, i + 1] = v
+            return mat
+
+        with open(path) as fh:
+            for line in fh:
+                toks = line.split()
+                if not toks:
+                    continue
+                start = 0
+                lab = 0.0
+                if ":" not in toks[0]:
+                    lab = float(toks[0])
+                    start = 1
+                labels.append(lab)
+                buf_rows.append([(int(t.split(":", 1)[0]),
+                                  float(t.split(":", 1)[1]))
+                                 for t in toks[start:] if ":" in t])
+                if len(buf_rows) >= chunk_rows:
+                    yield flush()
+                    buf_rows, labels = [], []
+        if buf_rows:
+            yield flush()
+        return
+
+    lines = _sniff_lines(path, 1)
+    hdr = _has_header(lines[0], sep) if header is None else header
+    try:
+        import pandas as pd
+        reader = pd.read_csv(path, sep=sep, header=0 if hdr else None,
+                             dtype=np.float64 if not hdr else None,
+                             na_values=["", "NA", "N/A", "nan", "NaN", "null"],
+                             chunksize=chunk_rows)
+        for df in reader:
+            yield df.to_numpy(dtype=np.float64)
+    except ImportError:
+        with open(path) as fh:
+            if hdr:
+                fh.readline()
+            rows = []
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rows.append([float("nan") if t in _NA_TOKENS else float(t)
+                             for t in line.split(sep)])
+                if len(rows) >= chunk_rows:
+                    yield np.asarray(rows, dtype=np.float64)
+                    rows = []
+            if rows:
+                yield np.asarray(rows, dtype=np.float64)
+
+
+def sample_stream(path: str, sample_cnt: int, seed: int = 1,
+                  chunk_rows: int = 65536, header: "Optional[bool]" = None):
+    """Pass 1: stream the file once, reservoir-sampling ``sample_cnt`` rows.
+
+    Returns (sample [k, D] float64, total_rows, num_cols) where num_cols for
+    LibSVM is the max feature index + 1 (label at column 0 like the CSV
+    layout stream_file produces)."""
+    fmt, sep = detect_format(path)
+    rng = np.random.RandomState(seed)
+    sample: List[np.ndarray] = []
+    total = 0
+
+    def offer(chunk):
+        nonlocal total
+        for r in range(chunk.shape[0]):
+            total += 1
+            if len(sample) < sample_cnt:
+                sample.append(chunk[r])
+            else:
+                j = rng.randint(0, total)
+                if j < sample_cnt:
+                    sample[j] = chunk[r]
+
+    if fmt == "libsvm":
+        # single pass: reservoir-sample RAW lines while tracking the width,
+        # parse the sampled lines at the end (two file reads total incl. the
+        # fill pass, like the reference's sample + re-read)
+        max_idx = -1
+        line_sample: List[str] = []
+        with open(path) as fh:
+            for line in fh:
+                if not line.strip():
+                    continue
+                for t in line.split():
+                    if ":" in t:
+                        i = int(t.split(":", 1)[0])
+                        if i > max_idx:
+                            max_idx = i
+                total += 1
+                if len(line_sample) < sample_cnt:
+                    line_sample.append(line)
+                else:
+                    j = rng.randint(0, total)
+                    if j < sample_cnt:
+                        line_sample[j] = line
+        num_cols = max_idx + 1
+        mat = np.zeros((len(line_sample), num_cols + 1), dtype=np.float64)
+        for r, line in enumerate(line_sample):
+            toks = line.split()
+            start = 0
+            if toks and ":" not in toks[0]:
+                mat[r, 0] = float(toks[0])
+                start = 1
+            for t in toks[start:]:
+                if ":" in t:
+                    i, v = t.split(":", 1)
+                    mat[r, int(i) + 1] = float(v)
+        return mat, total, num_cols
+    else:
+        num_cols = None
+        for chunk in stream_file(path, chunk_rows, header):
+            if num_cols is None:
+                num_cols = chunk.shape[1]
+            offer(chunk)
+    mat = (np.stack(sample) if sample
+           else np.zeros((0, num_cols or 0), dtype=np.float64))
+    return mat, total, num_cols

@@ -10,3 +10,9 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the suite is compile-dominated on a
+# single-core host (dozens of jitted tree-build programs), and the cache
+# makes re-runs take minutes instead of tens of minutes.
+jax.config.update("jax_compilation_cache_dir", "/tmp/lgbm_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
