@@ -1,0 +1,190 @@
+# R interface to the lightgbm_tpu framework.
+#
+# Mirrors the reference R package's main API (R-package/R/lgb.train.R,
+# lgb.Dataset.R, lgb.cv.R, lgb.Booster.R) over the framework's CLI and
+# reference-format text models instead of per-call C glue: each call writes a
+# train.conf-style config and invokes `python -m lightgbm_tpu`.  See
+# DESCRIPTION for the rationale.
+
+.lgb_python <- function() {
+  p <- Sys.getenv("LIGHTGBM_TPU_PYTHON", "python3")
+  p
+}
+
+.lgb_cli <- function(args, conf_lines, workdir) {
+  conf <- file.path(workdir, "run.conf")
+  writeLines(conf_lines, conf)
+  out <- suppressWarnings(system2(
+    .lgb_python(), c("-m", "lightgbm_tpu", paste0("config=", conf), args),
+    stdout = TRUE, stderr = TRUE))
+  status <- attr(out, "status")
+  if (!is.null(status) && status != 0) {
+    stop("lightgbm_tpu CLI failed:\n", paste(out, collapse = "\n"))
+  }
+  out
+}
+
+.lgb_params_to_conf <- function(params) {
+  vapply(names(params), function(k) {
+    v <- params[[k]]
+    if (is.logical(v)) v <- tolower(as.character(v))
+    paste0(k, " = ", paste(v, collapse = ","))
+  }, character(1))
+}
+
+.lgb_write_matrix <- function(data, label, path) {
+  # label first, tab-separated — the CLI's default label_column=0 layout
+  stopifnot(is.matrix(data) || is.data.frame(data))
+  m <- as.matrix(data)
+  if (is.null(label)) label <- rep(0, nrow(m))
+  utils::write.table(cbind(label, m), path, sep = "\t",
+                     row.names = FALSE, col.names = FALSE)
+}
+
+#' Create a dataset for lightgbm.tpu training.
+#'
+#' @param data a numeric matrix/data.frame, or a path to a data file in any
+#'   format the CLI loader reads (CSV/TSV/LibSVM).
+#' @param label response vector (ignored when data is a file path).
+#' @param weight optional per-row weights.
+#' @param group optional query sizes for ranking objectives.
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        params = list()) {
+  ds <- list(params = params)
+  if (is.character(data)) {
+    ds$file <- data
+    ds$owned <- FALSE
+  } else {
+    dir <- tempfile("lgb_tpu_ds_")
+    dir.create(dir)
+    ds$file <- file.path(dir, "data.train")
+    .lgb_write_matrix(data, label, ds$file)
+    if (!is.null(weight)) {
+      writeLines(format(weight, scientific = FALSE),
+                 paste0(ds$file, ".weight"))
+    }
+    if (!is.null(group)) {
+      writeLines(format(as.integer(group)), paste0(ds$file, ".query"))
+    }
+    ds$owned <- TRUE
+  }
+  class(ds) <- "lgb.Dataset"
+  ds
+}
+
+.lgb_booster <- function(model_file) {
+  stopifnot(file.exists(model_file))
+  b <- list(model_file = model_file,
+            model_str = paste(readLines(model_file), collapse = "\n"))
+  class(b) <- "lgb.Booster"
+  b
+}
+
+#' Train a gradient-boosted model (reference lgb.train counterpart).
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), verbose = 1L) {
+  stopifnot(inherits(data, "lgb.Dataset"))
+  workdir <- tempfile("lgb_tpu_run_")
+  dir.create(workdir)
+  model_file <- file.path(workdir, "model.txt")
+  conf <- c("task = train",
+            paste0("data = ", normalizePath(data$file)),
+            paste0("num_iterations = ", as.integer(nrounds)),
+            paste0("output_model = ", model_file),
+            .lgb_params_to_conf(c(data$params, params)))
+  if (length(valids)) {
+    vfiles <- vapply(valids, function(v) normalizePath(v$file), character(1))
+    conf <- c(conf, paste0("valid_data = ", paste(vfiles, collapse = ",")))
+  }
+  log <- .lgb_cli(character(0), conf, workdir)
+  if (verbose > 0) cat(paste(log, collapse = "\n"), "\n")
+  booster <- .lgb_booster(model_file)
+  booster$train_log <- log
+  booster
+}
+
+#' Simple interface (reference `lightgbm()` convenience wrapper).
+lightgbm <- function(data, label = NULL, params = list(), nrounds = 100L,
+                     verbose = 1L) {
+  lgb.train(params, lgb.Dataset(data, label = label), nrounds,
+            verbose = verbose)
+}
+
+#' k-fold cross validation (reference lgb.cv counterpart).
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
+                   verbose = 1L) {
+  stopifnot(inherits(data, "lgb.Dataset"), data$owned)
+  rows <- utils::read.table(data$file, sep = "\t")
+  n <- nrow(rows)
+  folds <- sample(rep_len(seq_len(nfold), n))
+  boosters <- vector("list", nfold)
+  for (k in seq_len(nfold)) {
+    dir <- tempfile("lgb_tpu_cv_")
+    dir.create(dir)
+    trf <- file.path(dir, "fold.train")
+    vaf <- file.path(dir, "fold.valid")
+    utils::write.table(rows[folds != k, ], trf, sep = "\t",
+                       row.names = FALSE, col.names = FALSE)
+    utils::write.table(rows[folds == k, ], vaf, sep = "\t",
+                       row.names = FALSE, col.names = FALSE)
+    tr <- lgb.Dataset(trf)
+    va <- lgb.Dataset(vaf)
+    boosters[[k]] <- lgb.train(params, tr, nrounds, valids = list(va),
+                               verbose = verbose)
+  }
+  structure(list(boosters = boosters, folds = folds), class = "lgb.CVBooster")
+}
+
+#' Predict with a trained booster.
+predict.lgb.Booster <- function(object, data, rawscore = FALSE,
+                                predleaf = FALSE, predcontrib = FALSE, ...) {
+  workdir <- tempfile("lgb_tpu_pred_")
+  dir.create(workdir)
+  if (is.character(data)) {
+    dfile <- normalizePath(data)
+  } else {
+    dfile <- file.path(workdir, "data.pred")
+    .lgb_write_matrix(data, NULL, dfile)
+  }
+  result <- file.path(workdir, "pred.txt")
+  conf <- c("task = predict",
+            paste0("data = ", dfile),
+            paste0("input_model = ", normalizePath(object$model_file)),
+            paste0("output_result = ", result),
+            if (rawscore) "predict_raw_score = true",
+            if (predleaf) "predict_leaf_index = true",
+            if (predcontrib) "predict_contrib = true")
+  .lgb_cli(character(0), conf, workdir)
+  pred <- utils::read.table(result, sep = "\t")
+  if (ncol(pred) == 1) pred[[1]] else as.matrix(pred)
+}
+
+#' Save a booster to the reference text-model format.
+lgb.save <- function(booster, filename) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  writeLines(booster$model_str, filename)
+  invisible(booster)
+}
+
+#' Load a booster from a reference-format model file.
+lgb.load <- function(filename) .lgb_booster(filename)
+
+#' Split-count feature importance parsed from the model text.
+lgb.importance <- function(booster) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  lines <- strsplit(booster$model_str, "\n")[[1]]
+  feats <- strsplit(sub("^feature_names=", "",
+                        grep("^feature_names=", lines, value = TRUE)), " ")[[1]]
+  counts <- integer(length(feats))
+  for (ln in grep("^split_feature=", lines, value = TRUE)) {
+    idx <- as.integer(strsplit(sub("^split_feature=", "", ln), " ")[[1]])
+    for (i in idx) counts[i + 1] <- counts[i + 1] + 1L
+  }
+  data.frame(Feature = feats, SplitCount = counts)
+}
+
+print.lgb.Booster <- function(x, ...) {
+  ntrees <- length(grep("^Tree=", strsplit(x$model_str, "\n")[[1]]))
+  cat(sprintf("<lgb.Booster: %d trees, model %s>\n", ntrees, x$model_file))
+  invisible(x)
+}

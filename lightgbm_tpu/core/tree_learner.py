@@ -413,6 +413,9 @@ class _PState(NamedTuple):
     lsum_h: jax.Array           # [L] leaf hessian totals
     feat_used: jax.Array        # [F] bool: feature split somewhere (CEGB)
     force_on: jax.Array         # scalar bool: forced schedule still aligned
+    fbc: object                 # FeatureBest arrays [L, F] — per-(leaf,
+                                # feature) cached candidates for the CEGB
+                                # coupled refund (() when CEGB is off)
 
 
 def _ffill_nonzero(x: jax.Array) -> jax.Array:
@@ -441,11 +444,11 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            has_monotone: bool = False,
                            feat_num_bins: int = 0,
                            unpack_lanes=None,
-                           forced=None, cegb=None,
+                           forced=None, cegb=None, paid_bits=None,
                            packed_cols: int = 0,
                            axis_name: str = "",
                            comm_mode: str = "psum",
-                           num_shards: int = 1) -> TreeArrays:
+                           num_shards: int = 1):
     """Leaf-wise growth with per-leaf physical row partitions.
 
     The TPU counterpart of the reference's ``DataPartition``
@@ -494,7 +497,14 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     f_cols = packed_cols or ncols      # histogrammed bin columns
     nbytes_bins = ncols * bpc
     voff = -(-nbytes_bins // 4) * 4
-    W = -(-(voff + 12) // 128) * 128
+    # CEGB lazy penalties track which rows already paid each feature's cost
+    # (feature_used_in_data_, cost_effective_gradient_boosting.hpp:47): one
+    # bit per (row, feature), carried as extra bytes IN the row store so the
+    # partition moves them for free
+    lazy_on = cegb is not None and cegb[3] is not None
+    bitoff = voff + 12
+    bitbytes = -(-f // 8) if lazy_on else 0
+    W = -(-(bitoff + bitbytes) // 128) * 128
     if bpc == 2:
         bins_u8 = jax.lax.bitcast_convert_type(
             bins, jnp.uint8).reshape(n, nbytes_bins)
@@ -507,8 +517,14 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     parts.append(jax.lax.bitcast_convert_type(hess.astype(f32), jnp.uint8))
     parts.append(jax.lax.bitcast_convert_type(
         jnp.arange(n, dtype=jnp.int32), jnp.uint8))
-    if W > voff + 12:
-        parts.append(jnp.zeros((n, W - voff - 12), jnp.uint8))
+    if lazy_on:
+        # rows that already paid lazy feature costs in EARLIER trees
+        # (feature_used_in_data_ lives for the whole training,
+        # cost_effective_gradient_boosting.hpp:47)
+        parts.append(paid_bits if paid_bits is not None
+                     else jnp.zeros((n, bitbytes), jnp.uint8))
+    if W > bitoff + bitbytes:
+        parts.append(jnp.zeros((n, W - bitoff - bitbytes), jnp.uint8))
     rows0 = jnp.concatenate(parts, axis=1)
 
     def hist_rows(rows_mat, start, count):
@@ -571,7 +587,10 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                         tiled=True)
         return jax.lax.psum(h, axis_name)
 
-    def best_of(h, sg, sh, cnt, cmn, cmx, used=None):
+    def best_of(h, sg, sh, cnt, cmn, cmx, used=None, ucnt=None):
+        """Best split of a leaf; with CEGB also returns the per-feature
+        candidates (the reference's splits_per_leaf_ cache,
+        cost_effective_gradient_boosting.hpp:35)."""
         if rs:
             fb = per_feature_best_combined(
                 h, feat_c, mask_c, sg, sh, cnt, params,
@@ -585,11 +604,18 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             cmin=cmn if has_monotone else None,
             cmax=cmx if has_monotone else None)
         if cegb is not None:
-            split_pen, coupled, _ = cegb
+            # DetlaGain (cost_effective_gradient_boosting.hpp:50-61):
+            # split penalty + coupled (until first use) + lazy on-demand
+            # cost for rows that have not paid the feature yet
+            split_pen, coupled, _, lazy = cegb
             penalty = (split_pen * cnt.astype(jnp.float32)
                        + jnp.where(used, 0.0, coupled))
+            if lazy_on:
+                penalty = penalty + lazy * jnp.maximum(
+                    cnt.astype(jnp.float32) - ucnt, 0.0)
             fb = fb._replace(gain=jnp.where(fb.gain > K_MIN_SCORE,
                                             fb.gain - penalty, fb.gain))
+            return reduce_feature_best(fb, jnp.arange(f, dtype=jnp.int32)), fb
         return reduce_feature_best(fb, jnp.arange(f, dtype=jnp.int32))
 
     def unpack_one(h, ffeat, sg, sh):
@@ -635,7 +661,10 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         in_sched = k <= s_max
         return fleaf, best, valid, in_sched
 
-    vmapped_best = jax.vmap(best_of, in_axes=(0, 0, 0, 0, 0, 0, None))
+    if cegb is not None:
+        vmapped_best = jax.vmap(best_of, in_axes=(0, 0, 0, 0, 0, 0, None, 0))
+    else:
+        vmapped_best = jax.vmap(best_of, in_axes=(0, 0, 0, 0, 0, 0, None))
 
     def make_branch(R):
         """Partition the parent window (size <= R) of the row store and
@@ -668,6 +697,14 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             cr = jnp.cumsum(inw & ~gl, dtype=jnp.int32)
             dest = jnp.where(gl, rel_b + cl - 1,
                              jnp.where(inw, rel_b + nl + cr - 1, iota))
+            if lazy_on:
+                # every row of the split leaf has now paid feat_id's lazy
+                # cost: set its bit (UpdateLeafBestSplits' InsertBitset loop)
+                lanes = jnp.arange(W, dtype=jnp.int32)
+                bit_col = bitoff + feat_id // 8
+                bit_val = (jnp.uint8(1) << (feat_id % 8).astype(jnp.uint8))
+                w = jnp.where((lanes[None, :] == bit_col) & inw[:, None],
+                              w | bit_val, w)
             w = jnp.zeros_like(w).at[dest].set(w, unique_indices=True)
             rows = jax.lax.dynamic_update_slice(rows, w, (s0, 0))
             # smaller child's histogram from the permuted window; the side is
@@ -676,7 +713,19 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             rel_s = jnp.where(left_smaller, rel_b, rel_b + nl)
             cnt_s = jnp.where(left_smaller, nl, c - nl)
             hist_small = hist_rows(w, rel_s, cnt_s)
-            return rows, hist_small, nl
+            if not lazy_on:
+                return rows, hist_small, nl
+            # per-child per-feature counts of rows whose bit is set (the
+            # CalculateOndemandCosts scan, amortized to one pass per split)
+            fi = np.arange(f)
+            bitmat = ((w[:, bitoff + fi // 8].astype(jnp.int32)
+                       >> jnp.asarray(fi % 8)) & 1).astype(f32)   # [R, F]
+            in_left = ((iota >= rel_b) & (iota < rel_b + nl)).astype(f32)
+            in_right = ((iota >= rel_b + nl)
+                        & (iota < rel_b + c)).astype(f32)
+            used_l = jnp.sum(bitmat * in_left[:, None], axis=0)
+            used_r = jnp.sum(bitmat * in_right[:, None], axis=0)
+            return rows, hist_small, nl, used_l, used_r
 
         return branch
 
@@ -695,7 +744,27 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     no_min = jnp.float32(-np.inf)
     no_max = jnp.float32(np.inf)
     used0 = (cegb[2] if cegb is not None else jnp.zeros((f,), bool))
-    best0 = best_of(hist0, sum_g, sum_h, num_data, no_min, no_max, used0)
+    if lazy_on:
+        # rows that pre-paid each feature's lazy cost in earlier trees
+        fi0 = np.arange(f)
+        pb0 = rows0[:, bitoff + fi0 // 8].astype(jnp.int32)
+        ucnt0 = jnp.sum(((pb0 >> jnp.asarray(fi0 % 8)) & 1).astype(f32),
+                        axis=0)
+        if axis_name:
+            ucnt0 = jax.lax.psum(ucnt0, axis_name)
+    else:
+        ucnt0 = jnp.zeros((f,), f32)
+    if cegb is not None:
+        best0, fb0 = best_of(hist0, sum_g, sum_h, num_data, no_min, no_max,
+                             used0, ucnt0)
+        fbc0 = type(fb0)(*[
+            jnp.full((L,) + x.shape,
+                     K_MIN_SCORE if name == "gain" else 0,
+                     dtype=x.dtype).at[0].set(x)
+            for name, x in zip(type(fb0)._fields, fb0)])
+    else:
+        best0 = best_of(hist0, sum_g, sum_h, num_data, no_min, no_max)
+        fbc0 = ()
 
     def zl(dtype=f32):
         return jnp.zeros((L,), dtype=dtype)
@@ -723,7 +792,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     lsum_g=zl().at[0].set(sum_g),
                     lsum_h=zl().at[0].set(sum_h),
                     feat_used=used0,
-                    force_on=jnp.bool_(True))
+                    force_on=jnp.bool_(True),
+                    fbc=fbc0)
 
     def body(k, st: _PState) -> _PState:
         node = k - 1
@@ -757,15 +827,23 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         wc = jnp.where(ok, st.wcount[leaf], 0)
         left_smaller = b.left_count <= b.right_count
         which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
-        rows_new, hist_small, nl = jax.lax.switch(
+        branch_out = jax.lax.switch(
             which, branches, st.rows, wb, wc,
             b.feature, b.threshold, b.default_left,
             feat.is_categorical[b.feature], b.cat_bitset, left_smaller)
+        if lazy_on:
+            rows_new, hist_small, nl, used_l, used_r = branch_out
+        else:
+            rows_new, hist_small, nl = branch_out
+            used_l = used_r = jnp.zeros((f,), f32)
         if axis_name:
             # per-split Allreduce (psum) or ReduceScatter (rs) of the
             # smaller child's histogram
             # (data_parallel_tree_learner.cpp:161 ReduceScatter)
             hist_small = reduce_hist(hist_small)
+            if lazy_on:
+                used_l = jax.lax.psum(used_l, axis_name)
+                used_r = jax.lax.psum(used_r, axis_name)
 
         def sel(new, old):
             """Masked state write: keep ``old`` on dead iterations."""
@@ -798,15 +876,70 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         feat_used = (st.feat_used | (jnp.arange(f) == b.feature)
                      if cegb is not None else st.feat_used)
-        child_best = vmapped_best(
-            jnp.stack([hist_left, hist_right]),
-            jnp.stack([b.left_sum_grad, b.right_sum_grad]),
-            jnp.stack([b.left_sum_hess, b.right_sum_hess]),
-            jnp.stack([b.left_count, b.right_count]),
-            jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
-            feat_used)
-        bests = _bests_update(st.bests, leaf,
-                              BestSplit(*[x[0] for x in child_best]))
+        if cegb is not None:
+            # coupled-penalty refund (UpdateLeafBestSplits,
+            # cost_effective_gradient_boosting.hpp:63-79): the first split on
+            # a feature makes its coupled cost sunk, so every other leaf's
+            # cached candidate for that feature gets the penalty back and is
+            # promoted when it now beats the leaf's cached best.  (The
+            # reference adds the refund to the PRE-penalty cached gain — a
+            # quirk that inflates promoted gains; here the cache holds
+            # penalized gains so the refund yields the intended value.)
+            coupled_arr = cegb[1]
+            fnew = b.feature
+            newly = ok & ~st.feat_used[fnew]
+            refund = jnp.where(newly, coupled_arr[fnew], 0.0)
+            fbc = st.fbc._replace(gain=st.fbc.gain.at[:, fnew].add(refund))
+            cand_gain = jnp.take(fbc.gain, fnew, axis=1)          # [L]
+            promote = (newly & (st.bests.gain > K_MIN_SCORE)
+                       & (cand_gain > st.bests.gain))
+
+            def pick(cand_field, old_field):
+                cand_col = jnp.take(cand_field, fnew, axis=1)
+                shape_tail = (1,) * (old_field.ndim - 1)
+                return jnp.where(promote.reshape((-1,) + shape_tail),
+                                 cand_col, old_field)
+
+            promoted = BestSplit(
+                gain=jnp.where(promote, cand_gain, st.bests.gain),
+                feature=jnp.where(promote, fnew, st.bests.feature),
+                threshold=pick(fbc.threshold, st.bests.threshold),
+                default_left=pick(fbc.default_left, st.bests.default_left),
+                left_sum_grad=pick(fbc.left_sum_grad,
+                                   st.bests.left_sum_grad),
+                left_sum_hess=pick(fbc.left_sum_hess,
+                                   st.bests.left_sum_hess),
+                left_count=pick(fbc.left_count, st.bests.left_count),
+                right_sum_grad=pick(fbc.right_sum_grad,
+                                    st.bests.right_sum_grad),
+                right_sum_hess=pick(fbc.right_sum_hess,
+                                    st.bests.right_sum_hess),
+                right_count=pick(fbc.right_count, st.bests.right_count),
+                left_output=pick(fbc.left_output, st.bests.left_output),
+                right_output=pick(fbc.right_output, st.bests.right_output),
+                cat_bitset=pick(fbc.cat_bitset, st.bests.cat_bitset))
+            child_best, child_fb = vmapped_best(
+                jnp.stack([hist_left, hist_right]),
+                jnp.stack([b.left_sum_grad, b.right_sum_grad]),
+                jnp.stack([b.left_sum_hess, b.right_sum_hess]),
+                jnp.stack([b.left_count, b.right_count]),
+                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+                feat_used, jnp.stack([used_l, used_r]))
+            fbc = type(fbc)(*[x.at[leaf].set(c[0]).at[k].set(c[1])
+                              for x, c in zip(fbc, child_fb)])
+            bests = _bests_update(promoted, leaf,
+                                  BestSplit(*[x[0] for x in child_best]))
+        else:
+            fbc = st.fbc
+            child_best = vmapped_best(
+                jnp.stack([hist_left, hist_right]),
+                jnp.stack([b.left_sum_grad, b.right_sum_grad]),
+                jnp.stack([b.left_sum_hess, b.right_sum_hess]),
+                jnp.stack([b.left_count, b.right_count]),
+                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+                feat_used)
+            bests = _bests_update(st.bests, leaf,
+                                  BestSplit(*[x[0] for x in child_best]))
         bests = _bests_update(bests, k, BestSplit(*[x[1] for x in child_best]))
 
         # parent child-pointer fixup (tree.h:338-346)
@@ -848,17 +981,17 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         lsum_h = st.lsum_h.at[leaf].set(b.left_sum_hess).at[k].set(
             b.right_sum_hess)
         small_new = (tree_new, bests, cmin_new, cmax_new, begin, wcount,
-                     lsum_g, lsum_h, feat_used)
+                     lsum_g, lsum_h, feat_used, fbc)
         small_old = (t, st.bests, st.cmin, st.cmax, st.begin, st.wcount,
-                     st.lsum_g, st.lsum_h, st.feat_used)
+                     st.lsum_g, st.lsum_h, st.feat_used, st.fbc)
         (tree_m, bests_m, cmin_m, cmax_m, begin_m, wcount_m, lsg_m, lsh_m,
-         fu_m) = jax.tree_util.tree_map(sel, small_new, small_old)
+         fu_m, fbc_m) = jax.tree_util.tree_map(sel, small_new, small_old)
         return _PState(tree=tree_m, hist=hist_new, bests=bests_m,
                        cont=ok, cmin=cmin_m, cmax=cmax_m,
                        begin=begin_m, wcount=wcount_m,
                        rows=rows_new,
                        lsum_g=lsg_m, lsum_h=lsh_m, feat_used=fu_m,
-                       force_on=st.force_on)
+                       force_on=st.force_on, fbc=fbc_m)
 
     if L > 1:
         state = jax.lax.fori_loop(1, L, body, state)
@@ -874,7 +1007,13 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     leaf_of_pos = _ffill_nonzero(marks) - 1
     row_leaf = jnp.zeros((n,), jnp.int32).at[order].set(
         leaf_of_pos, unique_indices=True)
-    return t._replace(row_leaf=row_leaf)
+    arrays = t._replace(row_leaf=row_leaf)
+    if lazy_on:
+        # paid-bit state back in ORIGINAL row order for the next tree
+        bits_out = jnp.zeros((n, bitbytes), jnp.uint8).at[order].set(
+            state.rows[:, bitoff:bitoff + bitbytes], unique_indices=True)
+        return arrays, bits_out
+    return arrays
 
 
 @functools.partial(jax.jit, static_argnames=("num_leaves",))
@@ -983,6 +1122,12 @@ class SerialTreeLearner:
         self.cegb = self._init_cegb(config, dataset)
         self.cegb_used = (jnp.zeros((dataset.num_features,), bool)
                           if self.cegb is not None else None)
+        # per-(row, feature) lazy-cost paid bits, persisted across trees
+        self.cegb_paid = None
+        if self.cegb is not None and self.cegb[2] is not None:
+            self.cegb_paid = jnp.zeros(
+                (self.num_data + self.padded_rows,
+                 -(-dataset.num_features // 8)), jnp.uint8)
 
     def _load_forced_splits(self, config, dataset):
         """BFS schedule from forcedsplits_filename
@@ -1025,27 +1170,31 @@ class SerialTreeLearner:
                 jnp.asarray(arr[:, 2]))
 
     def _init_cegb(self, config, dataset):
-        """(tradeoff*penalty_split, tradeoff*coupled [F]) when CEGB is active
+        """(tradeoff*penalty_split, tradeoff*coupled [F], tradeoff*lazy [F]
+        or None) when CEGB is active
         (cost_effective_gradient_boosting.hpp:25-31 IsEnable)."""
         tr = float(config.cegb_tradeoff)
         ps = float(config.cegb_penalty_split)
         coupled_cfg = list(config.cegb_penalty_feature_coupled or [])
         lazy_cfg = list(config.cegb_penalty_feature_lazy or [])
-        if lazy_cfg and any(v != 0 for v in lazy_cfg):
-            from ..utils.log import Log
-            Log.warning("cegb_penalty_feature_lazy is not supported on the "
-                        "TPU learner; the per-row on-demand cost is ignored")
-        if ps <= 0.0 and not any(coupled_cfg):
+        if ps <= 0.0 and not any(coupled_cfg) and not any(lazy_cfg):
             return None
+        from ..utils.log import Log
         if coupled_cfg and len(coupled_cfg) != dataset.num_total_features:
-            from ..utils.log import Log
             Log.fatal("cegb_penalty_feature_coupled should be the same size "
                       "as feature number.")
+        if lazy_cfg and len(lazy_cfg) != dataset.num_total_features:
+            Log.fatal("cegb_penalty_feature_lazy should be the same size "
+                      "as feature number.")
         coupled = np.zeros(dataset.num_features, dtype=np.float32)
+        lazy = np.zeros(dataset.num_features, dtype=np.float32)
         for j, orig in enumerate(dataset.used_feature_idx):
             if orig < len(coupled_cfg):
                 coupled[j] = tr * float(coupled_cfg[orig])
-        return (jnp.float32(tr * ps), jnp.asarray(coupled))
+            if orig < len(lazy_cfg):
+                lazy[j] = tr * float(lazy_cfg[orig])
+        return (jnp.float32(tr * ps), jnp.asarray(coupled),
+                jnp.asarray(lazy) if lazy.any() else None)
 
     def _pad_host_rows(self, binned: np.ndarray) -> np.ndarray:
         if self.padded_rows:
@@ -1074,8 +1223,10 @@ class SerialTreeLearner:
         grad = self.pad_rows(grad)
         hess = self.pad_rows(hess)
         cegb = (None if self.cegb is None
-                else (self.cegb[0], self.cegb[1], self.cegb_used))
-        arrays = build_tree_partitioned(
+                else (self.cegb[0], self.cegb[1], self.cegb_used,
+                      self.cegb[2]))
+        lazy_active = cegb is not None and cegb[3] is not None
+        out = build_tree_partitioned(
             self.bins, grad, hess,
             jnp.asarray(num_data_in_bag, dtype=jnp.int32),
             feature_mask, self.feat,
@@ -1087,7 +1238,14 @@ class SerialTreeLearner:
             feat_num_bins=self.feat_bins,
             unpack_lanes=self.unpack_lanes,
             forced=self.forced, cegb=cegb,
+            paid_bits=(self.cegb_paid if lazy_active else None),
             packed_cols=self.packed_cols)
+        if lazy_active:
+            # per-(row, feature) paid bits live for the whole training
+            # (feature_used_in_data_)
+            arrays, self.cegb_paid = out
+        else:
+            arrays = out
         if self.cegb is not None:
             # persist feature-used state across trees
             # (is_feature_used_in_split_ lives for the whole training)

@@ -128,6 +128,16 @@ def _default_allgather(num_machines: int):
     return gather
 
 
+def _qid_to_group_sizes(group_col: np.ndarray) -> np.ndarray:
+    """Per-row query ids -> group sizes by consecutive runs (metadata.h qid
+    semantics: rows of a query are contiguous; ids need not be sorted)."""
+    if len(group_col) == 0:
+        return np.zeros(0, dtype=np.int32)
+    boundaries = np.flatnonzero(np.diff(group_col)) + 1
+    edges = np.concatenate([[0], boundaries, [len(group_col)]])
+    return np.diff(edges).astype(np.int32)
+
+
 class _Columns:
     """Resolved column layout in FULL-file coordinates."""
 
@@ -198,6 +208,36 @@ class DatasetLoader:
     def __init__(self, config) -> None:
         self.config = config
 
+    def _side_files(self, filename: str, weight, group_col,
+                    begin: int, end: int):
+        """``.weight``/``.query``/``.init`` side files (metadata.cpp),
+        restricted to the rank stripe [begin, end)."""
+        weight_file = filename + ".weight"
+        if weight is None and os.path.exists(weight_file):
+            weight = np.loadtxt(weight_file, dtype=np.float64,
+                                ndmin=1)[begin:end]
+            Log.info("Reading weights from %s", weight_file)
+        group = None
+        query_file = filename + ".query"
+        if group_col is not None:
+            # per-row query ids -> group sizes (metadata.h qids)
+            group = _qid_to_group_sizes(group_col)
+        elif os.path.exists(query_file):
+            sizes = np.loadtxt(query_file, dtype=np.int64, ndmin=1)
+            # intersect the query runs with the stripe
+            edges = np.concatenate([[0], np.cumsum(sizes)])
+            clipped = np.clip(edges, begin, end) - begin
+            runs = np.diff(clipped)
+            group = runs[runs > 0].astype(np.int32)
+            Log.info("Reading query boundaries from %s", query_file)
+        init_score = None
+        init_file = filename + ".init"
+        if os.path.exists(init_file):
+            init_score = np.loadtxt(init_file, dtype=np.float64,
+                                    ndmin=1)[begin:end]
+            Log.info("Reading initial scores from %s", init_file)
+        return weight, group, init_score
+
     def load_from_file(self, filename: str, rank: int = 0,
                        num_machines: int = 1,
                        reference: Optional[BinnedDataset] = None
@@ -229,33 +269,18 @@ class DatasetLoader:
 
         # distributed loading: contiguous stripe per rank
         # (dataset_loader.cpp:168 pre_partition / sampled partitioning)
+        n_total = len(mat)
+        begin, end = 0, n_total
         if num_machines > 1 and self.config.pre_partition is False:
-            n = len(mat)
-            begin = n * rank // num_machines
-            end = n * (rank + 1) // num_machines
+            begin = n_total * rank // num_machines
+            end = n_total * (rank + 1) // num_machines
             mat = mat[begin:end]
             label = label[begin:end]
             weight = weight[begin:end] if weight is not None else None
             group_col = group_col[begin:end] if group_col is not None else None
 
-        weight_file = filename + ".weight"
-        if weight is None and os.path.exists(weight_file):
-            weight = np.loadtxt(weight_file, dtype=np.float64, ndmin=1)
-            Log.info("Reading weights from %s", weight_file)
-        group = None
-        query_file = filename + ".query"
-        if group_col is not None:
-            # per-row query ids -> group sizes (metadata.h qids)
-            _, counts = np.unique(group_col, return_counts=True)
-            group = counts.astype(np.int32)
-        elif os.path.exists(query_file):
-            group = np.loadtxt(query_file, dtype=np.int32, ndmin=1)
-            Log.info("Reading query boundaries from %s", query_file)
-        init_score = None
-        init_file = filename + ".init"
-        if os.path.exists(init_file):
-            init_score = np.loadtxt(init_file, dtype=np.float64, ndmin=1)
-            Log.info("Reading initial scores from %s", init_file)
+        weight, group, init_score = self._side_files(
+            filename, weight, group_col, begin, end)
 
         categorical = cols.categorical
         forced_bins = None
@@ -348,6 +373,9 @@ class DatasetLoader:
         n_kept = end - begin
 
         # schema (mappers + EFB groups) from the sample
+        forced_bins = None
+        if getattr(cfg, "forcedbins_filename", ""):
+            forced_bins = _load_forced_bins(cfg.forcedbins_filename)
         if reference is not None:
             schema = reference
         else:
@@ -364,6 +392,7 @@ class DatasetLoader:
                 data_random_seed=int(cfg.data_random_seed),
                 enable_bundle=bool(cfg.enable_bundle),
                 feature_names=feat_names, keep_raw=False,
+                forced_bins=forced_bins,
                 max_bin_by_feature=(list(cfg.max_bin_by_feature)
                                     if cfg.max_bin_by_feature else None))
 
@@ -415,27 +444,17 @@ class DatasetLoader:
 
         ds.metadata = Metadata(n_kept)
         ds.metadata.set_label(label)
-        group = None
-        if group_col is not None:
-            _, counts = np.unique(group_col, return_counts=True)
-            group = counts.astype(np.int32)
-        weight_file = filename + ".weight"
-        if weight is None and os.path.exists(weight_file):
-            weight = np.loadtxt(weight_file, dtype=np.float64,
-                                ndmin=1)[begin:end]
-            Log.info("Reading weights from %s", weight_file)
-        query_file = filename + ".query"
-        if group is None and os.path.exists(query_file):
-            group = np.loadtxt(query_file, dtype=np.int32, ndmin=1)
-            Log.info("Reading query boundaries from %s", query_file)
-        init_file = filename + ".init"
-        if os.path.exists(init_file):
-            ds.metadata.set_init_score(
-                np.loadtxt(init_file, dtype=np.float64, ndmin=1)[begin:end])
+        weight, group, init_score = self._side_files(
+            filename, weight, group_col, begin, end)
         if weight is not None:
             ds.metadata.set_weights(weight)
         if group is not None:
             ds.metadata.set_group(group)
+        if init_score is not None:
+            ds.metadata.set_init_score(init_score)
+        if bool(cfg.save_binary):
+            ds.save_binary(filename + ".bin")
+            Log.info("Saved binary dataset to %s.bin", filename)
         return ds
 
     def load_prediction_data(self, filename: str):

@@ -251,15 +251,20 @@ def sample_stream(path: str, sample_cnt: int, seed: int = 1,
     total = 0
 
     def offer(chunk):
+        """Vectorized reservoir step (Algorithm R): row at global position i
+        (1-based) replaces a random slot with probability k/i."""
         nonlocal total
-        for r in range(chunk.shape[0]):
-            total += 1
-            if len(sample) < sample_cnt:
-                sample.append(chunk[r])
-            else:
-                j = rng.randint(0, total)
-                if j < sample_cnt:
-                    sample[j] = chunk[r]
+        m = chunk.shape[0]
+        take = min(max(sample_cnt - len(sample), 0), m)
+        for r in range(take):
+            sample.append(chunk[r])
+        if take < m:
+            pos = total + np.arange(take + 1, m + 1)   # 1-based global index
+            js = (rng.random_sample(m - take) * pos).astype(np.int64)
+            acc = np.flatnonzero(js < sample_cnt)
+            for r in acc:           # few acceptances once the reservoir fills
+                sample[js[r]] = chunk[take + r]
+        total += m
 
     if fmt == "libsvm":
         # single pass: reservoir-sample RAW lines while tracking the width,

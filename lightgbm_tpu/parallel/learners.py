@@ -49,11 +49,16 @@ class _ParallelTreeLearner(SerialTreeLearner):
 
     def __init__(self, dataset, config, mesh: Optional[Mesh] = None) -> None:
         super().__init__(dataset, config)
-        if self.forced is not None or self.cegb is not None:
+        if (self.forced is not None or self.cegb is not None) \
+                and self.mode != "data_part":
             from ..utils.log import Log
-            Log.warning("forced splits / CEGB penalties are only applied by "
-                        "the serial tree learner; tree_learner=%s ignores "
-                        "them", self.mode)
+            Log.warning("forced splits / CEGB penalties need the full "
+                        "histogram block; tree_learner=%s (feature-sharded "
+                        "scan) ignores them — the psum data-parallel "
+                        "learner applies them", self.mode)
+            self.forced = None
+            self.cegb = None
+            self.cegb_used = None
         self.mesh = mesh if mesh is not None else default_mesh()
         self.num_shards = int(np.prod(self.mesh.devices.shape))
         self.axis = self.mesh.axis_names[0]
@@ -114,9 +119,9 @@ class _ParallelTreeLearner(SerialTreeLearner):
             out_specs=out_specs, check_vma=False)
         return jax.jit(shard_fn)
 
-    def train(self, grad: jax.Array, hess: jax.Array, num_data_in_bag,
-              feature_mask=None) -> TreeArrays:
-        # feature count, NOT the bins width (bins may be nibble-packed)
+    def _prep_train(self, grad, hess, feature_mask):
+        """Shared prologue: pad rows; feature mask padded to the sharded
+        feature count (NOT the bins width — bins may be nibble-packed)."""
         nf_padded = int(self.feat.num_bin.shape[0])
         if feature_mask is None:
             fm = np.ones(nf_padded, dtype=bool)
@@ -125,11 +130,14 @@ class _ParallelTreeLearner(SerialTreeLearner):
         else:
             fm = np.concatenate([np.asarray(feature_mask),
                                  np.zeros(self.feature_pad, dtype=bool)])
-        grad = self.pad_rows(grad)
-        hess = self.pad_rows(hess)
+        return self.pad_rows(grad), self.pad_rows(hess), jnp.asarray(fm)
+
+    def train(self, grad: jax.Array, hess: jax.Array, num_data_in_bag,
+              feature_mask=None) -> TreeArrays:
+        grad, hess, fm = self._prep_train(grad, hess, feature_mask)
         return self._build_fn(self.bins, grad, hess,
                               jnp.asarray(num_data_in_bag, dtype=jnp.int32),
-                              jnp.asarray(fm), self.feat)
+                              fm, self.feat)
 
 
 class DataParallelTreeLearner(_ParallelTreeLearner):
@@ -174,23 +182,64 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
     supports_groups = True
     supports_packing = True
 
+    def _lazy_active(self) -> bool:
+        return self.cegb is not None and self.cegb[2] is not None
+
     def _make_build_fn(self):
-        fn = functools.partial(
-            build_tree_partitioned, num_leaves=self.num_leaves,
-            max_depth=self.max_depth, params=self.params,
-            num_bins=self.num_bins, use_pallas=self.use_pallas,
-            has_categorical=self.has_categorical,
-            has_monotone=self.has_monotone,
-            feat_num_bins=self.feat_bins, unpack_lanes=self.unpack_lanes,
-            packed_cols=self.packed_cols, axis_name=self.axis)
+        forced = self.forced
+        lazy = self._lazy_active()
+
+        def fn(bins, grad, hess, nd, fm, feat, cegb_args, paid):
+            return build_tree_partitioned(
+                bins, grad, hess, nd, fm, feat,
+                num_leaves=self.num_leaves, max_depth=self.max_depth,
+                params=self.params, num_bins=self.num_bins,
+                use_pallas=self.use_pallas,
+                has_categorical=self.has_categorical,
+                has_monotone=self.has_monotone,
+                feat_num_bins=self.feat_bins,
+                unpack_lanes=self.unpack_lanes,
+                packed_cols=self.packed_cols, axis_name=self.axis,
+                forced=forced,
+                cegb=(cegb_args if cegb_args != () else None),
+                paid_bits=(paid if lazy else None))
+
         row = P(self.axis)
         out_specs = TreeArrays(
             *([P()] * len(TreeArrays._fields)))._replace(row_leaf=row)
+        if lazy:
+            out_specs = (out_specs, P(self.axis, None))
+        paid_spec = P(self.axis, None) if lazy else P()
         shard_fn = jax.shard_map(
             fn, mesh=self.mesh,
-            in_specs=(P(self.axis, None), row, row, P(), P(), P()),
+            in_specs=(P(self.axis, None), row, row, P(), P(), P(), P(),
+                      paid_spec),
             out_specs=out_specs, check_vma=False)
         return jax.jit(shard_fn)
+
+    def train(self, grad, hess, num_data_in_bag, feature_mask=None):
+        grad, hess, fm = self._prep_train(grad, hess, feature_mask)
+        cegb_args = (() if self.cegb is None else
+                     (self.cegb[0], self.cegb[1], self.cegb_used,
+                      self.cegb[2]))
+        lazy = self._lazy_active()
+        if lazy and self.cegb_paid.shape[0] != grad.shape[0]:
+            # repadded rows (mesh-divisible) after the serial-side init
+            self.cegb_paid = jnp.zeros(
+                (grad.shape[0], self.cegb_paid.shape[1]), jnp.uint8)
+        out = self._build_fn(self.bins, grad, hess,
+                             jnp.asarray(num_data_in_bag, dtype=jnp.int32),
+                             fm, self.feat, cegb_args,
+                             self.cegb_paid if lazy else ())
+        if lazy:
+            arrays, self.cegb_paid = out
+        else:
+            arrays = out
+        if self.cegb is not None:
+            valid = jnp.arange(self.num_leaves) < (arrays.num_leaves - 1)
+            self.cegb_used = self.cegb_used.at[arrays.split_feature].max(
+                valid)
+        return arrays
 
 
 class DataParallelPsumTreeLearner(_ParallelTreeLearner):
@@ -238,4 +287,13 @@ def create_tree_learner(dataset, config, mesh: Optional[Mesh] = None):
     if kind == "serial":
         return SerialTreeLearner(dataset, config)
     cls = _LEARNERS[kind]
+    if kind == "data" and (
+            str(getattr(config, "forcedsplits_filename", "") or "")
+            or float(config.cegb_penalty_split) > 0
+            or any(config.cegb_penalty_feature_coupled or [])
+            or any(config.cegb_penalty_feature_lazy or [])):
+        # forced splits / CEGB need every shard to hold the full histogram
+        # block (the reference applies them in the serial base class that all
+        # learners share); the psum data-parallel learner provides that
+        cls = PartitionedDataParallelTreeLearner
     return cls(dataset, config, mesh=mesh)

@@ -1,0 +1,31 @@
+/* SWIG interface for the lightgbm_tpu C ABI (the role of the reference's
+ * swig/lightgbmlib.i for lib_lightgbm: a Java binding over the C API, used
+ * by JVM callers such as MMLSpark).  Generate the header first:
+ *     python tools/build_capi.py swig/
+ * then:
+ *     swig -java -package io.lightgbm_tpu -outdir java swig/lightgbmlib.i
+ *     gcc -shared -fPIC lightgbmlib_wrap.c -I$JAVA_HOME/include \
+ *         -I$JAVA_HOME/include/linux -L. -l_lightgbm_tpu -o liblightgbmlib.so
+ */
+%module lightgbmlib
+
+%{
+#include "lightgbm_tpu_c_api.h"
+%}
+
+%include "stdint.i"
+%include "carrays.i"
+%include "cpointer.i"
+
+/* handle out-params and buffers the way the reference binding does */
+%array_functions(double, doubleArray)
+%array_functions(float, floatArray)
+%array_functions(int, intArray)
+%array_functions(int32_t, int32Array)
+%array_functions(int64_t, int64Array)
+%pointer_functions(int, intp)
+%pointer_functions(int64_t, int64p)
+%pointer_functions(double, doublep)
+%pointer_functions(void*, voidpp)
+
+%include "lightgbm_tpu_c_api.h"

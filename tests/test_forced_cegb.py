@@ -98,3 +98,74 @@ def test_cegb_coupled_penalty_narrows_features(data):
     imp_pen = pen.feature_importance("split")
     assert imp_pen[2:].sum() < imp_base[2:].sum()
     assert imp_pen[:2].sum() > 0
+
+
+def test_cegb_lazy_routes_to_cheap_features():
+    """Per-row lazy costs (cost_effective_gradient_boosting.hpp
+    CalculateOndemandCosts): a feature with zero lazy cost wins over
+    stronger-but-expensive ones, and a uniform prohibitive cost stops
+    growth entirely (the cost scales with the leaf's unpaid rows)."""
+    rng = np.random.RandomState(5)
+    n = 4000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] + 0.95 * X[:, 1] + 0.2 * X[:, 3]
+         + rng.normal(scale=0.3, size=n))
+    cheap3 = _train(X, y, cegb_penalty_feature_lazy=[10.0, 10.0, 10.0, 0.0,
+                                                     10.0])
+    feats = {int(t.split_feature[i]) for t in cheap3.models
+             for i in range(t.num_leaves - 1)}
+    assert feats == {3}, feats
+    blocked = _train(X, y, cegb_penalty_feature_lazy=[10.0] * 5)
+    assert sum(t.num_leaves - 1 for t in blocked.models) == 0
+
+
+def test_cegb_coupled_refund_promotes_cached_candidates():
+    """First use of a feature refunds its coupled penalty in other leaves'
+    cached candidates (UpdateLeafBestSplits): with a coupled penalty on a
+    strong feature, once any leaf pays it the rest of the tree uses the
+    feature freely — so it appears in multiple nodes, not just one."""
+    rng = np.random.RandomState(6)
+    n = 4000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    # one dominant feature, nonlinear so it wants several splits
+    y = (np.sin(2 * X[:, 0]) * 2 + 0.2 * X[:, 1]
+         + rng.normal(scale=0.2, size=n))
+    booster = _train(X, y,
+                     cegb_penalty_feature_coupled=[3.0, 3.0, 3.0, 3.0])
+    splits_on_0 = sum(int(t.split_feature[i]) == 0 for t in booster.models
+                     for i in range(t.num_leaves - 1))
+    total_splits = sum(t.num_leaves - 1 for t in booster.models)
+    assert total_splits > 2
+    assert splits_on_0 >= 2, (splits_on_0, total_splits)
+
+
+def test_forced_splits_data_parallel(data, tmp_path):
+    """tree_learner=data honors forced splits (routed to the psum learner
+    whose shards hold the full histogram block)."""
+    import json
+    X, y = data
+    spec = {"feature": 5, "threshold": 0.25}
+    fname = str(tmp_path / "forced.json")
+    with open(fname, "w") as fh:
+        json.dump(spec, fh)
+    from lightgbm_tpu.parallel import PartitionedDataParallelTreeLearner
+    b = _train(X, y, tree_learner="data", forcedsplits_filename=fname)
+    assert isinstance(b.learner, PartitionedDataParallelTreeLearner)
+    for t in b.models:
+        assert int(t.split_feature[0]) == 5
+        assert abs(float(t.threshold[0]) - 0.25) < 0.1
+
+
+def test_cegb_lazy_paid_bits_persist_across_trees():
+    """feature_used_in_data_ lives for the whole training: rows that paid a
+    feature's lazy cost in tree 1 are not charged again in tree 2."""
+    rng = np.random.RandomState(7)
+    n = 3000
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (X[:, 0] + rng.normal(scale=0.3, size=n))
+    b = _train(X, y, cegb_penalty_feature_lazy=[0.01, 0.01, 0.01])
+    bits = np.asarray(b.learner.cegb_paid)
+    assert bits.shape[1] == 1          # ceil(3/8) bytes per row
+    assert (bits & 1).any()            # rows paid feature 0 in some tree
+    # later trees still split: the paid rows make feature 0 free again
+    assert all(t.num_leaves > 1 for t in b.models)

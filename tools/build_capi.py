@@ -163,12 +163,43 @@ lightgbm_tpu.c_api.bind(ffi)
 """
 
 
+HEADER_PRELUDE = """\
+/* lightgbm_tpu_c_api.h — generated by tools/build_capi.py.
+ * The LGBM_* ABI of lib_lightgbm_tpu.so (mirrors the reference's
+ * include/LightGBM/c_api.h surface); consumed by the SWIG wrapper
+ * (swig/lightgbmlib.i) and any external C caller. */
+#ifndef LIGHTGBM_TPU_C_API_H_
+#define LIGHTGBM_TPU_C_API_H_
+#include <stdint.h>
+#ifdef __cplusplus
+extern "C" {
+#endif
+"""
+
+HEADER_EPILOGUE = """\
+#ifdef __cplusplus
+}
+#endif
+#endif  /* LIGHTGBM_TPU_C_API_H_ */
+"""
+
+
+def write_header(out_dir: str) -> str:
+    path = os.path.join(out_dir, "lightgbm_tpu_c_api.h")
+    with open(path, "w") as fh:
+        fh.write(HEADER_PRELUDE)
+        fh.write(CDEF)
+        fh.write(HEADER_EPILOGUE)
+    return path
+
+
 def build(out_dir: str) -> str:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ffibuilder = cffi.FFI()
     ffibuilder.embedding_api(CDEF)
     ffibuilder.set_source("lightgbm_tpu_capi", "")
     ffibuilder.embedding_init_code(INIT_CODE % repo)
+    write_header(out_dir)
     return ffibuilder.compile(tmpdir=out_dir, target="lib_lightgbm_tpu.*",
                               verbose=False)
 
