@@ -127,8 +127,8 @@ lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
                        row.names = FALSE, col.names = FALSE)
     utils::write.table(rows[folds == k, ], vaf, sep = "\t",
                        row.names = FALSE, col.names = FALSE)
-    tr <- lgb.Dataset(trf)
-    va <- lgb.Dataset(vaf)
+    tr <- lgb.Dataset(trf, params = data$params)
+    va <- lgb.Dataset(vaf, params = data$params)
     boosters[[k]] <- lgb.train(params, tr, nrounds, valids = list(va),
                                verbose = verbose)
   }

@@ -111,8 +111,15 @@ class GBDT:
             self._materialize_pending()
         return self._models
 
+    def _invalidate_predict_cache(self) -> None:
+        """Bump the model generation: any in-place tree surgery (refit, leaf
+        edits, shuffles, rollback) must not serve stale stacked predictions."""
+        self._stacked_pred = None
+        self._model_gen = getattr(self, "_model_gen", 0) + 1
+
     @models.setter
     def models(self, value) -> None:
+        self._invalidate_predict_cache()
         self._models: List[Tree] = list(value)
         self._pending: Dict[int, Tuple[TreeArrays, float]] = {}
         # device arrays of trees materialized since the last poll, kept so a
@@ -757,6 +764,7 @@ class GBDT:
 
     def rollback_one_iter(self) -> None:
         """Undo the last iteration (gbdt.cpp:454-470)."""
+        self._invalidate_predict_cache()
         if self.iter_ <= 0:
             return
         for k in range(self.num_tree_per_iteration):
@@ -791,6 +799,7 @@ class GBDT:
         boosting state, blend by ``refit_decay_rate``, and rebuild the train
         scores progressively.
         """
+        self._invalidate_predict_cache()
         models = self.models
         leaf_preds = np.asarray(leaf_preds, dtype=np.int32)
         if leaf_preds.ndim != 2 or leaf_preds.shape[0] != self.num_data \
@@ -860,6 +869,7 @@ class GBDT:
     def shuffle_models(self, start_iter: int = 0, end_iter: int = -1) -> None:
         """Shuffle tree order in [start_iter, end_iter) iterations
         (gbdt.h ShuffleModels; used when merging boosters)."""
+        self._invalidate_predict_cache()
         models = self.models
         K = self.num_tree_per_iteration
         total_iter = len(models) // K
@@ -878,6 +888,7 @@ class GBDT:
 
     def set_leaf_value(self, tree_idx: int, leaf_idx: int, value: float) -> None:
         """Directly set one leaf's output (c_api.cpp LGBM_BoosterSetLeafValue)."""
+        self._invalidate_predict_cache()
         tree = self.models[tree_idx]
         if not 0 <= leaf_idx < tree.num_leaves:
             raise IndexError("leaf index %d out of range" % leaf_idx)
@@ -994,6 +1005,22 @@ class GBDT:
                                         early_stop_margin=margin,
                                         round_period=freq)
             return out
+        if margin < 0 and len(sel) > 0:
+            # cached flat-array ensemble: the reference's SingleRowPredictor
+            # role (c_api.cpp:52-98) for small batches
+            from ..core.predict import (StackedTreesPredictor,
+                                        has_categorical_splits)
+            if not has_categorical_splits(sel):
+                key = (start_iteration, end_iter, len(self.models),
+                       getattr(self, "_model_gen", 0))
+                cached = getattr(self, "_stacked_pred", None)
+                if cached is None or cached[0] != key:
+                    cached = (key, [StackedTreesPredictor(sel[k::K])
+                                    for k in range(K)])
+                    self._stacked_pred = cached
+                for k in range(K):
+                    out[k] = cached[1][k].raw_predict(X)
+                return out
         active = np.ones(n, dtype=bool)
         for j, tree in enumerate(sel):
             pred = tree.predict(X[active]) if margin >= 0 else tree.predict(X)

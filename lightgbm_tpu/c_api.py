@@ -329,6 +329,11 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
         keepalive["last_error"] = buf
         return buf
 
+    @ffi.def_extern(name="LGBM_SetLastError")
+    def _set_last_error_c(msg):
+        # c_api.h:1040 — embedding hosts stash their own error text
+        _set_last_error(_str(msg))
+
     # ---- dataset creation ----
 
     @export("LGBM_DatasetCreateFromFile")

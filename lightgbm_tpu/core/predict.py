@@ -183,3 +183,65 @@ def predict_device(trees: List[Tree], X: np.ndarray,
         score, leaves = out
         return np.asarray(leaves[:n]).astype(np.int32)
     return np.asarray(out[:n], dtype=np.float64)
+
+
+class StackedTreesPredictor:
+    """Flat-array ensemble for small-batch / single-row host prediction.
+
+    The counterpart of the reference's cached ``SingleRowPredictor``
+    (src/c_api.cpp:52-98): tree arrays are stacked once into [T, M] matrices
+    so a predict call is ONE numpy traversal vectorized over (rows, trees)
+    instead of a Python loop over trees.  Numerical splits only — callers
+    guard with :func:`has_categorical_splits`."""
+
+    def __init__(self, trees) -> None:
+        import numpy as np
+        self.T = T = len(trees)
+        M = max(max(t.num_leaves - 1, 1) for t in trees)
+        L = max(max(t.num_leaves, 1) for t in trees)
+        self.depth = int(max((t.leaf_depth.max() if t.num_leaves > 1 else 0)
+                             for t in trees)) + 1
+        self.sf = np.zeros((T, M), dtype=np.int64)
+        self.thr = np.zeros((T, M), dtype=np.float64)
+        self.default_left = np.zeros((T, M), dtype=bool)
+        self.mt = np.zeros((T, M), dtype=np.int64)
+        self.lc = np.zeros((T, M), dtype=np.int32)
+        self.rc = np.zeros((T, M), dtype=np.int32)
+        self.leaf_value = np.zeros((T, L), dtype=np.float64)
+        self.start = np.zeros(T, dtype=np.int32)
+        for t, tree in enumerate(trees):
+            ni = max(tree.num_leaves - 1, 0)
+            if ni == 0:
+                self.start[t] = -1          # single leaf: ~0
+            self.sf[t, :ni] = tree.split_feature[:ni]
+            self.thr[t, :ni] = tree.threshold[:ni]
+            dt = tree.decision_type[:ni].astype(np.int64)
+            self.default_left[t, :ni] = (dt & 2) > 0
+            self.mt[t, :ni] = (dt >> 2) & 3
+            self.lc[t, :ni] = tree.left_child[:ni]
+            self.rc[t, :ni] = tree.right_child[:ni]
+            self.leaf_value[t, :tree.num_leaves] = \
+                tree.leaf_value[:tree.num_leaves]
+
+    def raw_predict(self, X) -> "np.ndarray":
+        """[n, D] raw features -> [n] summed leaf values across trees."""
+        import numpy as np
+        n = len(X)
+        ti = np.arange(self.T)[None, :]
+        node = np.broadcast_to(self.start[None, :], (n, self.T)).copy()
+        rows = np.arange(n)[:, None]
+        for _ in range(self.depth):
+            live = node >= 0
+            if not live.any():
+                break
+            nd = np.maximum(node, 0)
+            fval = X[rows, self.sf[ti, nd]]
+            mt = self.mt[ti, nd]
+            val = np.where(np.isnan(fval) & (mt != 2), 0.0, fval)
+            is_missing = (((mt == 1) & (np.abs(val) <= 1e-35))
+                          | ((mt == 2) & np.isnan(val)))
+            go_left = np.where(is_missing, self.default_left[ti, nd],
+                               val <= self.thr[ti, nd])
+            nxt = np.where(go_left, self.lc[ti, nd], self.rc[ti, nd])
+            node = np.where(live, nxt, node)
+        return self.leaf_value[ti, ~node].sum(axis=1)

@@ -1246,12 +1246,16 @@ class SerialTreeLearner:
             arrays, self.cegb_paid = out
         else:
             arrays = out
-        if self.cegb is not None:
-            # persist feature-used state across trees
-            # (is_feature_used_in_split_ lives for the whole training)
-            valid = jnp.arange(self.num_leaves) < (arrays.num_leaves - 1)
-            self.cegb_used = self.cegb_used.at[arrays.split_feature].max(valid)
+        self._update_cegb_used(arrays)
         return arrays
+
+    def _update_cegb_used(self, arrays: TreeArrays) -> None:
+        """Persist feature-used state across trees
+        (is_feature_used_in_split_ lives for the whole training)."""
+        if self.cegb is None:
+            return
+        valid = jnp.arange(self.num_leaves) < (arrays.num_leaves - 1)
+        self.cegb_used = self.cegb_used.at[arrays.split_feature].max(valid)
 
     def route_bins_matrix(self) -> jax.Array:
         """Training bins with one column per group column (unpacked view for

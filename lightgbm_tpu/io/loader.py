@@ -17,6 +17,7 @@ import numpy as np
 from .dataset import BinnedDataset
 from .metadata import Metadata
 from .parser import detect_format, parse_file
+from ..utils import file_io
 from ..utils.log import Log
 
 
@@ -213,17 +214,18 @@ class DatasetLoader:
         """``.weight``/``.query``/``.init`` side files (metadata.cpp),
         restricted to the rank stripe [begin, end)."""
         weight_file = filename + ".weight"
-        if weight is None and os.path.exists(weight_file):
-            weight = np.loadtxt(weight_file, dtype=np.float64,
-                                ndmin=1)[begin:end]
+        if weight is None and file_io.exists(weight_file):
+            with file_io.open_file(weight_file) as fh:
+                weight = np.loadtxt(fh, dtype=np.float64, ndmin=1)[begin:end]
             Log.info("Reading weights from %s", weight_file)
         group = None
         query_file = filename + ".query"
         if group_col is not None:
             # per-row query ids -> group sizes (metadata.h qids)
             group = _qid_to_group_sizes(group_col)
-        elif os.path.exists(query_file):
-            sizes = np.loadtxt(query_file, dtype=np.int64, ndmin=1)
+        elif file_io.exists(query_file):
+            with file_io.open_file(query_file) as fh:
+                sizes = np.loadtxt(fh, dtype=np.int64, ndmin=1)
             # intersect the query runs with the stripe
             edges = np.concatenate([[0], np.cumsum(sizes)])
             clipped = np.clip(edges, begin, end) - begin
@@ -232,9 +234,10 @@ class DatasetLoader:
             Log.info("Reading query boundaries from %s", query_file)
         init_score = None
         init_file = filename + ".init"
-        if os.path.exists(init_file):
-            init_score = np.loadtxt(init_file, dtype=np.float64,
-                                    ndmin=1)[begin:end]
+        if file_io.exists(init_file):
+            with file_io.open_file(init_file) as fh:
+                init_score = np.loadtxt(fh, dtype=np.float64,
+                                        ndmin=1)[begin:end]
             Log.info("Reading initial scores from %s", init_file)
         return weight, group, init_score
 
@@ -243,7 +246,7 @@ class DatasetLoader:
                        reference: Optional[BinnedDataset] = None
                        ) -> BinnedDataset:
         cfg = self.config
-        if not os.path.exists(filename):
+        if not file_io.exists(filename):
             Log.fatal("Data file %s does not exist", filename)
         if _is_binary_file(filename):
             ds = BinnedDataset.load_binary(filename)
@@ -471,7 +474,7 @@ class DatasetLoader:
 
 
 def _is_binary_file(path: str) -> bool:
-    with open(path, "rb") as fh:
+    with file_io.open_file(path, "rb") as fh:
         return fh.read(8) == BinnedDataset.MAGIC
 
 

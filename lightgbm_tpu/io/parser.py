@@ -12,12 +12,14 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.file_io import open_file
+
 from ..utils.log import Log
 
 
 def _sniff_lines(path: str, k: int = 32) -> List[str]:
     lines = []
-    with open(path, "r") as fh:
+    with open_file(path, "r") as fh:
         for line in fh:
             line = line.strip("\r\n")
             if line:
@@ -113,7 +115,7 @@ def _parse_libsvm(path: str, label_idx: int
     labels: List[float] = []
     rows: List[List[Tuple[int, float]]] = []
     max_idx = -1
-    with open(path) as fh:
+    with open_file(path) as fh:
         for line in fh:
             line = line.strip()
             if not line:
@@ -189,7 +191,7 @@ def stream_file(path: str, chunk_rows: int = 65536,
                         mat[r, i + 1] = v
             return mat
 
-        with open(path) as fh:
+        with open_file(path) as fh:
             for line in fh:
                 toks = line.split()
                 if not toks:
@@ -221,7 +223,7 @@ def stream_file(path: str, chunk_rows: int = 65536,
         for df in reader:
             yield df.to_numpy(dtype=np.float64)
     except ImportError:
-        with open(path) as fh:
+        with open_file(path) as fh:
             if hdr:
                 fh.readline()
             rows = []
@@ -272,7 +274,7 @@ def sample_stream(path: str, sample_cnt: int, seed: int = 1,
         # fill pass, like the reference's sample + re-read)
         max_idx = -1
         line_sample: List[str] = []
-        with open(path) as fh:
+        with open_file(path) as fh:
             for line in fh:
                 if not line.strip():
                     continue

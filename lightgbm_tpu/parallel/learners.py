@@ -235,10 +235,7 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
             arrays, self.cegb_paid = out
         else:
             arrays = out
-        if self.cegb is not None:
-            valid = jnp.arange(self.num_leaves) < (arrays.num_leaves - 1)
-            self.cegb_used = self.cegb_used.at[arrays.split_feature].max(
-                valid)
+        self._update_cegb_used(arrays)
         return arrays
 
 

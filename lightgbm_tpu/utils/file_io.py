@@ -1,0 +1,62 @@
+"""Virtual file IO — scheme-dispatched readers/writers.
+
+Counterpart of the reference's ``VirtualFileReader``/``VirtualFileWriter``
+(src/io/file_io.cpp:62-134, utils/file_io.h): local files by default, with a
+registry for remote schemes.  ``hdfs://`` routes through ``pyarrow.fs`` when
+available (the reference links libhdfs under USE_HDFS); other schemes can be
+registered by embedding hosts.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_SCHEMES: Dict[str, Callable] = {}
+
+
+def register_scheme(prefix: str, opener: Callable) -> None:
+    """Register ``opener(path, mode) -> file object`` for ``prefix://``."""
+    _SCHEMES[prefix] = opener
+
+
+def _hdfs_open(path: str, mode: str):
+    try:
+        from pyarrow import fs as pafs
+    except ImportError as exc:  # pragma: no cover - env without pyarrow
+        raise OSError(
+            "hdfs:// paths need pyarrow (the reference builds with USE_HDFS "
+            "and libhdfs; here pyarrow.fs provides the client)") from exc
+    hdfs, rel = pafs.FileSystem.from_uri(path)
+    if "r" in mode:
+        stream = hdfs.open_input_stream(rel)
+    else:
+        stream = hdfs.open_output_stream(rel)
+    if "b" not in mode:
+        import io
+        return io.TextIOWrapper(stream)
+    return stream
+
+
+register_scheme("hdfs", _hdfs_open)
+
+
+def open_file(path: str, mode: str = "r"):
+    """Open ``path`` locally or via a registered ``scheme://`` handler."""
+    if "://" in path:
+        scheme = path.split("://", 1)[0]
+        opener = _SCHEMES.get(scheme)
+        if opener is None:
+            raise OSError("No file-IO handler registered for scheme %r "
+                          "(register_scheme)" % scheme)
+        return opener(path, mode)
+    return open(path, mode)
+
+
+def exists(path: str) -> bool:
+    import os
+    if "://" in path:
+        try:
+            with open_file(path, "rb"):
+                return True
+        except OSError:
+            return False
+    return os.path.exists(path)

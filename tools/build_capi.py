@@ -152,6 +152,7 @@ int LGBM_NetworkInit(const char* machines, int local_listen_port,
 int LGBM_NetworkFree();
 int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
     void* reduce_scatter_ext_fun, void* allgather_ext_fun);
+void LGBM_SetLastError(const char* msg);
 """
 
 INIT_CODE = """
