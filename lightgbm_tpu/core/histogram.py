@@ -35,6 +35,7 @@ dense_bin.hpp:48 ConstructHistogram over ``data_indices`` begin..end).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +43,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _LANE = 128
+
+
+def _exact_hist() -> bool:
+    """Parity-debugging escape hatch: accumulate histograms with f32 HIGHEST
+    contractions instead of the bf16 hi/lo split (~2^-16 relative error).
+    Roughly 2x slower; flip when chasing near-tie split divergences vs the
+    reference's double-precision accumulation."""
+    return os.environ.get("LIGHTGBM_TPU_EXACT_HIST", "0") == "1"
 
 
 def _pad_bins(num_bins: int) -> int:
@@ -82,10 +91,15 @@ def _padded_features(num_features: int, num_bins: int) -> int:
     return -(-num_features // fp) * fp
 
 
-def _hilo_split(vals, axis):
+def _hilo_split(vals, axis, exact: bool = False):
     """f32 -> (hi, lo) bf16 concatenated on ``axis``: bf16 products against a
     0/1 one-hot are exact and hi+lo recovers ~f32 precision (relative error
-    ~2^-16) in a single MXU pass instead of the 6-pass f32 emulation."""
+    ~2^-16) in a single MXU pass instead of the 6-pass f32 emulation.
+
+    ``exact``: keep f32 and pad with zeros (the contraction then runs at
+    HIGHEST precision — see :func:`_exact_hist`)."""
+    if exact:
+        return jnp.concatenate([vals, jnp.zeros_like(vals)], axis=axis)
     hi = vals.astype(jnp.bfloat16)
     lo = (vals - hi.astype(jnp.float32)).astype(jnp.bfloat16)
     return jnp.concatenate([hi, lo], axis=axis)
@@ -113,15 +127,17 @@ def _accum_onehot_tiles(col, v4, out_ref, *, num_features: int,
                     break
                 m = (col(f) + j * B) == iota
                 oh = m if oh is None else oh | m
+        exact = v4.dtype == jnp.float32
         acc = jax.lax.dot_general(
-            v4, oh.astype(jnp.bfloat16), (((contract_dim,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [4, 128]
+            v4, oh.astype(v4.dtype), (((contract_dim,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST if exact else None)  # [4, 128]
         out_ref[:, t * _LANE:(t + 1) * _LANE] += acc
 
 
 def _hist_kernel_mxu(win_ref, bins_ref, vals_ref, out_ref, *,
                      num_features: int, num_bins: int, row_tile: int,
-                     packed: bool):
+                     packed: bool, exact: bool = False):
     """One row tile's contribution to the histogram of rows in
     [win[0], win[0]+win[1]).  out_ref: [4, F_pad * num_bins] f32 — rows are
     (grad_hi, hess_hi, grad_lo, hess_lo); the caller folds hi+lo."""
@@ -138,7 +154,7 @@ def _hist_kernel_mxu(win_ref, bins_ref, vals_ref, out_ref, *,
     def _accum():
         rows = base + jax.lax.broadcasted_iota(jnp.int32, (1, row_tile), 1)
         in_w = ((rows >= start) & (rows < start + count)).astype(jnp.float32)
-        v4 = _hilo_split(vals_ref[...] * in_w, axis=0)   # [4, Nt] bf16
+        v4 = _hilo_split(vals_ref[...] * in_w, axis=0, exact=exact)  # [4, Nt]
         bins = bins_ref[...].astype(jnp.int32)
 
         def col(f):
@@ -151,11 +167,12 @@ def _hist_kernel_mxu(win_ref, bins_ref, vals_ref, out_ref, *,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_tile",
-                                             "num_cols", "interpret"))
+                                             "num_cols", "interpret", "exact"))
 def histogram_pallas_masked(bins: jax.Array, values: jax.Array, num_bins: int,
                             start: jax.Array, count: jax.Array,
                             row_tile: int = 2048, num_cols: int = 0,
-                            interpret: bool = False) -> jax.Array:
+                            interpret: bool = False,
+                            exact: bool = False) -> jax.Array:
     """Histogram over rows [start, start+count) of a (bucket-sized) slice.
 
     bins: [R, F] int (or [R, ceil(F/2)] nibble-packed when ``num_cols`` = F);
@@ -173,7 +190,7 @@ def histogram_pallas_masked(bins: jax.Array, values: jax.Array, num_bins: int,
     win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
     kernel = functools.partial(_hist_kernel_mxu, num_features=f,
                                num_bins=num_bins, row_tile=row_tile,
-                               packed=bool(num_cols))
+                               packed=bool(num_cols), exact=exact)
 
     def _in_idx(i, win_ref):
         # tiles outside the window revisit block 0: Mosaic elides the re-fetch
@@ -205,9 +222,12 @@ def histogram_pallas_masked(bins: jax.Array, values: jax.Array, num_bins: int,
     return folded.reshape(2, f_pad, num_bins).transpose(1, 0, 2)[:f]
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "row_tile", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "row_tile", "interpret",
+                                    "exact"))
 def histogram_pallas(bins: jax.Array, values: jax.Array, num_bins: int,
-                     row_tile: int = 2048, interpret: bool = False) -> jax.Array:
+                     row_tile: int = 2048, interpret: bool = False,
+                     exact: bool = False) -> jax.Array:
     """Pallas TPU histogram over ALL rows (values pre-masked).
 
     bins: [N, F] int (any small int dtype); values: [2, N] f32 channel-major.
@@ -216,12 +236,32 @@ def histogram_pallas(bins: jax.Array, values: jax.Array, num_bins: int,
     n = bins.shape[0]
     return histogram_pallas_masked(bins, values, num_bins, jnp.int32(0),
                                    jnp.int32(n), row_tile=row_tile,
-                                   interpret=interpret)
+                                   interpret=interpret, exact=exact)
+
+
+def _f32_from_bytes(ti, off: int):
+    """Little-endian f32 from 4 byte-lanes of an i32-converted row tile.
+
+    Implemented as ONE weighted lane reduction (weights 1, 2^8, 2^16, 2^24;
+    i32 wrap-around reproduces the high byte's sign bit exactly since the four
+    terms have disjoint bits).  The obvious form — OR-ing four shifted
+    single-lane slices — is MISCOMPILED by Mosaic on real TPUs (intermittent
+    zeroed bytes per row; verified on v5e, and the cause of a silent ~28%
+    histogram mass loss in the round-3 kernel).  Single-lane slices alone are
+    fine; the fused shift/OR chain is not.  Do not "simplify" this back.
+    """
+    w = ti.shape[1]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+    weight = ((lanes == off) * 1 + (lanes == off + 1) * (1 << 8)
+              + (lanes == off + 2) * (1 << 16)
+              + (lanes == off + 3) * (1 << 24))
+    word = jnp.sum(ti * weight, axis=1, keepdims=True)
+    return jax.lax.bitcast_convert_type(word, jnp.float32)
 
 
 def _hist_kernel_rows(win_ref, rows_ref, out_ref, *, num_features: int,
                       num_bins: int, row_tile: int, packed: bool,
-                      voff: int, bpc: int):
+                      voff: int, bpc: int, exact: bool = False):
     """Combined-row-store histogram: ``rows`` is [Nt, W] u8 with bin codes in
     bytes [0, num_cols*bpc), grad/hess f32 little-endian at byte offsets
     voff/voff+4.  One operand means the partitioned tree builder carries ONE
@@ -242,17 +282,11 @@ def _hist_kernel_rows(win_ref, rows_ref, out_ref, *, num_features: int,
         pos = base + jax.lax.broadcasted_iota(jnp.int32, (row_tile, 1), 0)
         in_w = (pos >= start) & (pos < start + count)
 
-        def f32_at(off):
-            word = (w[:, off:off + 1] | (w[:, off + 1:off + 2] << 8)
-                    | (w[:, off + 2:off + 3] << 16)
-                    | (w[:, off + 3:off + 4] << 24))
-            return jax.lax.bitcast_convert_type(word, jnp.float32)
-
         zero = jnp.float32(0.0)
-        g = jnp.where(in_w, f32_at(voff), zero)
-        h = jnp.where(in_w, f32_at(voff + 4), zero)
+        g = jnp.where(in_w, _f32_from_bytes(w, voff), zero)
+        h = jnp.where(in_w, _f32_from_bytes(w, voff + 4), zero)
         vals = jnp.concatenate([g, h], axis=1)           # [Nt, 2] f32
-        v4 = _hilo_split(vals, axis=1)                   # [Nt, 4] bf16
+        v4 = _hilo_split(vals, axis=1, exact=exact)      # [Nt, 4]
 
         def col(f):
             if packed:
@@ -267,12 +301,13 @@ def _hist_kernel_rows(win_ref, rows_ref, out_ref, *, num_features: int,
 
 @functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
                                              "voff", "bpc", "row_tile",
-                                             "packed", "interpret"))
+                                             "packed", "interpret", "exact"))
 def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
                           count: jax.Array, *, num_features: int, voff: int,
                           bpc: int = 1, packed: bool = False,
                           row_tile: int = 2048,
-                          interpret: bool = False) -> jax.Array:
+                          interpret: bool = False,
+                          exact: bool = False) -> jax.Array:
     """Histogram over rows [start, start+count) of a combined row store.
 
     rows: [R, W] u8 — bins bytes + f32 grad/hess at voff/voff+4 (see
@@ -287,7 +322,8 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
     win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
     kernel = functools.partial(_hist_kernel_rows, num_features=num_features,
                                num_bins=num_bins, row_tile=row_tile,
-                               packed=packed, voff=voff, bpc=bpc)
+                               packed=packed, voff=voff, bpc=bpc,
+                               exact=exact)
 
     def _in_idx(i, win_ref):
         active = ((i * row_tile < win_ref[0] + win_ref[1])
@@ -341,7 +377,8 @@ def histogram_rows(rows: jax.Array, num_bins: int, start, count, *,
     if use_pallas and rows.shape[0] % 2048 == 0:
         return histogram_pallas_rows(rows, num_bins, start, count,
                                      num_features=num_features, voff=voff,
-                                     bpc=bpc, packed=packed)
+                                     bpc=bpc, packed=packed,
+                                     exact=_exact_hist())
     bins, values = rows_split_xla(rows, num_features, voff, bpc, packed)
     return histogram_xla_masked(bins, values, num_bins, start, count)
 
@@ -361,7 +398,8 @@ def build_histogram(bins: jax.Array, values: jax.Array, num_bins: int,
     if use_pallas:
         tile = _pick_tile(bins.shape[0])
         if tile is not None:
-            return histogram_pallas(bins, values, num_bins, row_tile=tile)
+            return histogram_pallas(bins, values, num_bins, row_tile=tile,
+                                    exact=_exact_hist())
     return histogram_xla(bins, values, num_bins)
 
 

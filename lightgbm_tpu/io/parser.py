@@ -216,12 +216,19 @@ def stream_file(path: str, chunk_rows: int = 65536,
     hdr = _has_header(lines[0], sep) if header is None else header
     try:
         import pandas as pd
-        reader = pd.read_csv(path, sep=sep, header=0 if hdr else None,
-                             dtype=np.float64 if not hdr else None,
-                             na_values=["", "NA", "N/A", "nan", "NaN", "null"],
-                             chunksize=chunk_rows)
-        for df in reader:
-            yield df.to_numpy(dtype=np.float64)
+        import contextlib
+        # registered schemes (hdfs:// etc.) go through open_file; plain local
+        # paths are handed to pandas directly so its C reader owns the file
+        src_cm = (open_file(path) if "://" in path
+                  else contextlib.nullcontext(path))
+        with src_cm as src:
+            reader = pd.read_csv(
+                src, sep=sep, header=0 if hdr else None,
+                dtype=np.float64 if not hdr else None,
+                na_values=["", "NA", "N/A", "nan", "NaN", "null"],
+                chunksize=chunk_rows)
+            for df in reader:
+                yield df.to_numpy(dtype=np.float64)
     except ImportError:
         with open_file(path) as fh:
             if hdr:
@@ -258,14 +265,16 @@ def sample_stream(path: str, sample_cnt: int, seed: int = 1,
         nonlocal total
         m = chunk.shape[0]
         take = min(max(sample_cnt - len(sample), 0), m)
+        # .copy(): keeping views would pin every streamed chunk in memory,
+        # defeating the two_round loader's O(sample + chunk) footprint
         for r in range(take):
-            sample.append(chunk[r])
+            sample.append(chunk[r].copy())
         if take < m:
             pos = total + np.arange(take + 1, m + 1)   # 1-based global index
             js = (rng.random_sample(m - take) * pos).astype(np.int64)
             acc = np.flatnonzero(js < sample_cnt)
             for r in acc:           # few acceptances once the reservoir fills
-                sample[js[r]] = chunk[take + r]
+                sample[js[r]] = chunk[take + r].copy()
         total += m
 
     if fmt == "libsvm":

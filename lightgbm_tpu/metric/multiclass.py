@@ -96,24 +96,64 @@ class AucMuMetric(Metric):
     @staticmethod
     def _pair_auc(dist, is_i):
         """S[i][j]/(n_i*n_j): fraction of (i, j) pairs ranked correctly, ties
-        half (the reference's sorted sweep, multiclass_metric.hpp:258-280)."""
-        order = np.argsort(dist, kind="mergesort")
-        d_sorted = dist[order]
-        i_sorted = is_i[order]
-        # per distance-tie group: j's strictly below contribute 1, j's at the
-        # same distance one half (the reference adds num_j when untied and
-        # num_j - 0.5*num_current_j when tied with the current j run)
-        _, inv = np.unique(d_sorted, return_inverse=True)
-        j_cum = np.concatenate([[0], np.cumsum(~i_sorted)])
-        group_start = np.concatenate([[0], np.flatnonzero(np.diff(inv)) + 1])
-        j_before_group = j_cum[group_start][inv]
-        j_in_group = np.bincount(inv, weights=(~i_sorted).astype(np.float64))[inv]
-        s = j_before_group + 0.5 * j_in_group
-        total = float(np.sum(s[i_sorted]))
+        half (the reference's sorted sweep, multiclass_metric.hpp:258-280).
+
+        Tie semantics follow the reference exactly: an i compares against the
+        ANCHOR of the current j-run (``last_j_dist``) with kEpsilon tolerance,
+        not against its own neighbors; exact-equal scores sort class j first
+        (the comparator at :250-251)."""
+        k_eps = 1e-15
+        order = np.lexsort((is_i, dist))
+        d = dist[order]
+        ii = is_i[order]
+        n = d.size
         n_i = float(np.sum(is_i))
         n_j = float(np.sum(~is_i))
         if n_i == 0 or n_j == 0:
             return 1.0  # no rankable pairs; same credit as both-absent
+        # j's strictly before each position
+        j_before = np.concatenate([[0.0], np.cumsum(~ii)])[:-1]
+        close = np.diff(d) < k_eps
+        if not close.any():
+            # no epsilon-near neighbors: every i credits all j's before it
+            return float(np.sum(j_before[ii])) / (n_i * n_j)
+        # a >=eps gap between consecutive elements also separates an element
+        # from every earlier anchor (anchors only move up), so chained
+        # eps-clusters are independent; run the anchored sweep inside each
+        total = 0.0
+        boundaries = np.flatnonzero(~close) + 1
+        starts = np.concatenate([[0], boundaries])
+        ends = np.concatenate([boundaries, [n]])
+        for s, e in zip(starts, ends):
+            if e - s == 1:
+                if ii[s]:
+                    total += j_before[s]
+                continue
+            if d[e - 1] - d[s] < k_eps:
+                # whole cluster within kEpsilon of its first element: the
+                # anchor never resets, so every i credits j_before + half the
+                # j's that sorted before it.  Vectorized — iteration 0 has ALL
+                # scores tied and would otherwise run an O(n) Python sweep.
+                seg_i = ii[s:e]
+                cum_j = np.cumsum(~seg_i)
+                total += float(np.sum(j_before[s] + 0.5 * cum_j[seg_i]))
+                continue
+            num_j = 0.0
+            last_j = None
+            num_cur = 0.0
+            for t in range(s, e):
+                if ii[t]:
+                    if last_j is not None and abs(d[t] - last_j) < k_eps:
+                        total += j_before[s] + num_j - 0.5 * num_cur
+                    else:
+                        total += j_before[s] + num_j
+                else:
+                    num_j += 1.0
+                    if last_j is not None and abs(d[t] - last_j) < k_eps:
+                        num_cur += 1.0
+                    else:
+                        last_j = d[t]
+                        num_cur = 1.0
         return total / (n_i * n_j)
 
     def eval(self, score, objective=None):

@@ -9,7 +9,11 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# LGBM_TPU_TEST_TPU=1 runs the suite against the real accelerator instead
+# (tests/test_tpu_numerics.py needs it: Mosaic lowering bugs are invisible in
+# interpret mode)
+if os.environ.get("LGBM_TPU_TEST_TPU", "0") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compilation cache: the suite is compile-dominated on a
 # single-core host (dozens of jitted tree-build programs), and the cache

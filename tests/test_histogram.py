@@ -38,6 +38,18 @@ def test_histogram_pallas_interpret_matches_xla():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+def test_histogram_pallas_exact_mode_tight_tolerance():
+    """LIGHTGBM_TPU_EXACT_HIST path: f32 HIGHEST contraction should match a
+    float64 reference to near machine precision (the bf16 hi/lo default is
+    only ~2^-16 relative), so near-tie split parity can be debugged."""
+    bins, vals = make(n=2048, f=4, b=128, seed=3)
+    want = reference_hist(bins, vals, 128)
+    got = np.asarray(histogram_pallas(jnp.asarray(bins), jnp.asarray(vals),
+                                      128, row_tile=1024, interpret=True,
+                                      exact=True))
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-5)
+
+
 def test_histogram_masked_rows_contribute_nothing():
     bins, vals = make()
     vals[:, 500:] = 0.0  # masked-out rows
