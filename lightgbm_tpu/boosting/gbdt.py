@@ -299,6 +299,13 @@ class GBDT:
     # ---- scores ----
 
     def _gather_tree_output(self, arrays: TreeArrays) -> jnp.ndarray:
+        if arrays.row_leaf.shape[0] == 0:
+            # carried-mode trees drop the original-order row_leaf (their
+            # per-row state lives in the permuted store); route the bins
+            leaf = route_binned(self.learner.route_bins_matrix(), arrays,
+                                self.learner.feat,
+                                num_leaves=int(self.config.num_leaves))
+            return arrays.leaf_value[leaf]
         return arrays.leaf_value[arrays.row_leaf]
 
     def _tree_to_device(self, tree: Tree) -> TreeArrays:
@@ -557,7 +564,89 @@ class GBDT:
 
     _fuse_failed = False
 
+    def _can_carry_rows(self) -> bool:
+        """Carried-row-store training: per-row boosting state (aux, score)
+        rides the tree builder's permutation so no per-row gather/scatter
+        happens between iterations.  Needs a single-model pointwise objective
+        with no sample weights and the serial partitioned learner."""
+        if self.num_tree_per_iteration != 1:
+            return False
+        if self.objective is None or self.objective.carry_aux() is None:
+            return False
+        if type(self.learner).__name__ != "SerialTreeLearner":
+            return False
+        return True
+
+    def _make_fused_train_carried(self, k: int):
+        objective = self.objective
+        learner = self.learner
+        rate = float(self.shrinkage_rate)
+        n = self.num_data
+        ntot = n + learner.padded_rows
+        feat = learner.feat
+        fm = jnp.ones((self.train_data.num_features,), bool)
+        nd = jnp.int32(n)
+        lay = learner.row_layout()
+        voff, aoff, soff = lay["voff"], lay["aoff"], lay["soff"]
+        aux = learner.pad_rows(objective.carry_aux().astype(jnp.float32))
+        kwargs = dict(num_leaves=learner.num_leaves,
+                      max_depth=learner.max_depth, params=learner.params,
+                      num_bins=learner.num_bins, use_pallas=learner.use_pallas,
+                      has_categorical=learner.has_categorical,
+                      has_monotone=learner.has_monotone,
+                      feat_num_bins=learner.feat_bins,
+                      unpack_lanes=learner.unpack_lanes,
+                      forced=learner.forced,
+                      packed_cols=learner.packed_cols,
+                      carried=True)
+
+        def f32col(rows, off):
+            w = jax.lax.bitcast_convert_type(
+                rows[:, off:off + 4], jnp.int32).reshape(rows.shape[0])
+            return jax.lax.bitcast_convert_type(w, jnp.float32)
+
+        def one_iter(rows, _):
+            score = f32col(rows, soff)
+            auxv = f32col(rows, aoff)
+            order = jax.lax.bitcast_convert_type(
+                rows[:, voff + 8:voff + 12], jnp.int32).reshape(rows.shape[0])
+            validf = (order < n).astype(jnp.float32)
+            g, h = objective.pointwise_gradients(score, auxv)
+            g = g * validf
+            h = h * validf
+            arr, rows = build_tree_partitioned(
+                learner.bins, g[:ntot], h[:ntot], nd, fm, feat,
+                rows_carry=rows, score_rate=jnp.float32(rate), **kwargs)
+            arr = arr._replace(
+                leaf_value=arr.leaf_value * rate,
+                internal_value=arr.internal_value * rate)
+            return rows, (arr,)
+
+        def fused(score):
+            # construct the initial store from the ORIGINAL row order; the
+            # num_leaves=1 build is a no-op tree whose only effect is the
+            # store construction (leaf values stay 0, score unchanged)
+            init_kwargs = dict(kwargs)
+            init_kwargs["num_leaves"] = 1
+            zero = jnp.zeros((ntot,), jnp.float32)
+            _, rows0 = build_tree_partitioned(
+                learner.bins, zero, zero, nd, fm, feat,
+                extra=(aux, score[0, :ntot]),
+                score_rate=jnp.float32(rate), **init_kwargs)
+            rows_fin, stacked = jax.lax.scan(one_iter, rows0, None, length=k)
+            sc = f32col(rows_fin, soff)
+            order = jax.lax.bitcast_convert_type(
+                rows_fin[:, voff + 8:voff + 12], jnp.int32
+            ).reshape(rows_fin.shape[0])
+            score_out = jnp.zeros((ntot,), jnp.float32).at[order].set(
+                sc, mode="drop")
+            return score_out[None], stacked
+
+        return jax.jit(fused)
+
     def _make_fused_train(self, k: int):
+        if self._can_carry_rows():
+            return self._make_fused_train_carried(k)
         objective = self.objective
         learner = self.learner
         K = self.num_tree_per_iteration

@@ -41,45 +41,21 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .histogram import (_accum_onehot_tiles, _f32_from_bytes, _hilo_split,
-                        _padded_features, histogram_xla_masked, rows_split_xla)
-
-# f32 extraction must use the weighted-lane reduction form; see the Mosaic
-# miscompilation note on histogram._f32_from_bytes
-_f32_at = _f32_from_bytes
+from .histogram import (_accum_onehot_tiles, _hilo_split, _padded_features,
+                        histogram_xla_masked, rows_split_xla)
 
 _LANE = 128
 _ALIGN = 32          # u8 sublane tile: dynamic DMA offsets must be 32-row mult
 CHUNK = 2048         # rows per streamed DMA tile
-T = 512              # rows per placement subtile (one P matmul)
-TS = 512             # staging/flush tile (rows per contiguous write-back)
+T = 256              # rows per placement subtile (one P matmul)
+TS = 256             # staging/flush tile (rows per contiguous write-back)
+NB = 12              # flush-ring depth per stream (>= CHUNK/TS + 2 so a
+                     # whole chunk can blend before its flushes start)
 # The single-flush circular staging depends on nls <= TS per subtile (at most
 # one stage wrap per append) and the subtile loop covering the chunk exactly;
 # retuning one constant without the other silently corrupts the partition.
 assert T == TS and CHUNK % T == 0 and T % _ALIGN == 0 and TS % _ALIGN == 0
-
-
-def _cumsum_tri(ltri_ref, sel_f):
-    """Inclusive prefix sum of a [T, 1] f32 0/1 vector via a lower-triangular
-    ones matmul (vector-form cumsum over sublanes is vreg-padded ~64x on TPU;
-    one tiny MXU matmul is cheaper)."""
-    return jax.lax.dot_general(
-        ltri_ref[...], sel_f, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)          # [T, 1]
-
-
-def _extract_col(ti, gcol, *, W, bpc, packed):
-    """Bin code of group column ``gcol`` (dynamic) from an i32 row-store tile
-    ``ti`` [T, W] -> [T, 1] i32.  Mirrors tree_learner.col_from_rows."""
-    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
-    if packed:
-        byte = jnp.sum(ti * (lanes == gcol // 2), axis=1, keepdims=True)
-        return jnp.where(gcol % 2 == 1, (byte >> 4) & 15, byte & 15)
-    if bpc == 2:
-        lo = jnp.sum(ti * (lanes == 2 * gcol), axis=1, keepdims=True)
-        hi = jnp.sum(ti * (lanes == 2 * gcol + 1), axis=1, keepdims=True)
-        return lo | (hi << 8)
-    return jnp.sum(ti * (lanes == gcol), axis=1, keepdims=True)
+assert NB * TS >= CHUNK + 2 * TS
 
 
 def _route_tile(col, scal_ref, num_bins):
@@ -118,14 +94,20 @@ def _route_tile(col, scal_ref, num_bins):
 
 
 def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
-                           packed, exact):
+                           packed, exact, dbg_skip=""):
     del n_pad  # shapes come from the refs; kept for cache-key clarity
 
     def kernel(scal_ref, rows_in_ref, rows_ref, scratch_ref, hist_ref,
-               stats_ref, inbuf, stage, ltri, rot, tmp,
-               sem_in, sem_pre, sem_fl, sem_fr, sem_cb):
+               stats_ref, inbuf, stage, ltri, rot, tmp, comp_buf,
+               totals_vm, totals_sm,
+               sem_in, sem_pre, sem_fl, sem_fr, sem_cb, sem_tot):
         # rows_in_ref is the pre-alias view of rows_ref (same buffer); all
-        # reads and writes go through rows_ref so ordering is explicit
+        # reads and writes go through rows_ref so ordering is explicit.
+        # stage is a [2*NB, TS, W] ring: slots [0, NB) buffer the left
+        # stream, [NB, 2*NB) the right stream.  Flush DMAs are ASYNC — a
+        # slot's previous flush is awaited only when the ring wraps back to
+        # it (NB-1 flushes of slack), so the VPU/MXU never stalls on HBM
+        # writes (sync flushes were ~60% of the kernel in round-4 profiles).
         del rows_in_ref
         wb = scal_ref[0]
         wc = scal_ref[1]
@@ -137,16 +119,21 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         nchunks = (headL + wc + CHUNK - 1) // CHUNK
 
         hist_ref[...] = jnp.zeros_like(hist_ref)
-        # lower-triangular ones (inclusive prefix-sum operator)
+        # lower-triangular ones: subtiles are STACKED ALONG N so one
+        # [T,T]@[T,2*nsub] dot computes every subtile's local prefix — a
+        # skinny N=2 prefix matmul is MXU weight-load bound (~2.3us each)
         ltri[...] = (jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
                      >= jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
                      ).astype(jnp.bfloat16)
 
+        def left_dst(nf):
+            return pl.multiple_of(wb_al + nf * TS, _ALIGN)
+
         # prefill the left stage's head with the old rows [wb_al, wb) so the
         # first aligned flush preserves the neighbour leaf's rows
         cp = pltpu.make_async_copy(
-            rows_ref.at[pl.ds(wb_al, _ALIGN)], stage.at[pl.ds(0, _ALIGN)],
-            sem_pre)
+            rows_ref.at[pl.ds(wb_al, _ALIGN)],
+            stage.at[0, pl.ds(0, _ALIGN)], sem_pre)
         cp.start()
         cp.wait()
 
@@ -156,12 +143,24 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 rows_ref.at[pl.ds(wb_al, CHUNK)], inbuf.at[0], sem_in.at[0]
             ).start()
 
-        iota2ts = jax.lax.broadcasted_iota(jnp.int32, (2 * TS, 1), 0)
         iota1x2ts = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * TS), 1)
-        iota_t = jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+        iota_ts = jax.lax.broadcasted_iota(jnp.int32, (TS, 1), 0)
+
+        def wait_left(m):
+            sl = jax.lax.rem(m, NB)
+            pltpu.make_async_copy(
+                stage.at[sl], rows_ref.at[pl.ds(left_dst(m), TS)],
+                sem_fl.at[sl]).wait()
+
+        def wait_right(m):
+            sl = jax.lax.rem(m, NB)
+            pltpu.make_async_copy(
+                stage.at[NB + sl],
+                scratch_ref.at[pl.ds(pl.multiple_of(m * TS, _ALIGN), TS)],
+                sem_fr.at[sl]).wait()
 
         def chunk_body(c, carry):
-            fillL, fillR, nfL, nfR = carry
+            fillL, fillR, nfL, nfR, wdL, wdR = carry
             slot = jax.lax.rem(c, 2)
             pltpu.make_async_copy(
                 rows_ref.at[pl.ds(pl.multiple_of(wb_al + c * CHUNK, _ALIGN),
@@ -178,109 +177,219 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                     inbuf.at[nxt], sem_in.at[nxt]).start()
 
             abs0 = wb_al + c * CHUNK
-            for s in range(CHUNK // T):
-                tile = inbuf[slot, s * T:(s + 1) * T, :]        # [T, W] u8
-                ti = tile.astype(jnp.int32)
-                col = _extract_col(ti, gcol, W=W, bpc=bpc, packed=packed)
-                gl = _route_tile(col, scal_ref, num_bins)        # i32 0/1
-                pos = abs0 + s * T + iota_t
-                inw = ((pos >= wb).astype(jnp.int32)
-                       * (pos < wb + wc).astype(jnp.int32))
-                selL = gl * inw                                  # i32 0/1
-                selR = (1 - gl) * inw
-                pfxL = _cumsum_tri(ltri, selL.astype(jnp.float32)
-                                   ).astype(jnp.int32)           # [T, 1]
-                pfxR = _cumsum_tri(ltri, selR.astype(jnp.float32)
-                                   ).astype(jnp.int32)
-                nls = pfxL[T - 1, 0]
-                nrs = pfxR[T - 1, 0]
-                startL = jax.lax.rem(headL + fillL, TS)
-                startR = jax.lax.rem(fillR, TS)
-                destL = jax.lax.rem(startL + pfxL - 1, TS)
-                destR = TS + jax.lax.rem(startR + pfxR - 1, TS)
+            nsub = CHUNK // T
+            # ---- phase A (vector): convert, route, per-subtile prefixes.
+            # One u8->i32 conversion, one column extraction, one routing
+            # pass per chunk; per-subtile totals land in SMEM via ONE DMA
+            # (direct vector->scalar extraction costs ~0.7us EACH on v5e and
+            # serialized the whole pipeline at 6 ns/row).
+            ti_chunk = inbuf[slot].astype(jnp.int32)         # [CHUNK, W]
+            ti_bf = ti_chunk.astype(jnp.bfloat16)            # hoisted for B
+            # ONE MXU dot extracts the split column and the g/h bytes for the
+            # whole chunk: lane-masked VPU reductions cost ~thousands of
+            # vreg-ops per chunk, a [CHUNK,W]@[W,8] dot ~0.2us.  Byte values
+            # (<=255) are exact in bf16; 16-bit halves keep f32 accumulation
+            # exact; i32 wrap reassembles the sign bit.
+            lanes_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+            if packed:
+                colsel = (lanes_w == gcol // 2).astype(jnp.bfloat16)
+                colsel2 = jnp.zeros((1, W), jnp.bfloat16)
+            elif bpc == 2:
+                colsel = (lanes_w == 2 * gcol).astype(jnp.bfloat16)
+                colsel2 = (lanes_w == 2 * gcol + 1).astype(jnp.bfloat16)
+            else:
+                colsel = (lanes_w == gcol).astype(jnp.bfloat16)
+                colsel2 = jnp.zeros((1, W), jnp.bfloat16)
+            bw = [(lanes_w == off).astype(jnp.bfloat16)
+                  + (lanes_w == off + 1).astype(jnp.bfloat16) * 256
+                  for off in (voff, voff + 2, voff + 4, voff + 6)]
+            wmat = jnp.concatenate([colsel, colsel2] + bw, axis=0)  # [6, W]
+            ext = jax.lax.dot_general(
+                ti_bf, wmat, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [CHUNK, 6]
+            exti = ext.astype(jnp.int32)
+            if packed:
+                byte = exti[:, 0:1]
+                col_chunk = jnp.where(gcol % 2 == 1, (byte >> 4) & 15,
+                                      byte & 15)
+            elif bpc == 2:
+                col_chunk = exti[:, 0:1] | (exti[:, 1:2] << 8)
+            else:
+                col_chunk = exti[:, 0:1]
+            g_chunk = jax.lax.bitcast_convert_type(
+                exti[:, 2:3] | (exti[:, 3:4] << 16), jnp.float32)
+            h_chunk = jax.lax.bitcast_convert_type(
+                exti[:, 4:5] | (exti[:, 5:6] << 16), jnp.float32)
+            if "route" in dbg_skip:
+                gl_chunk = col_chunk & 1
+            else:
+                gl_chunk = _route_tile(col_chunk, scal_ref, num_bins)
+            pos_chunk = abs0 + jax.lax.broadcasted_iota(
+                jnp.int32, (CHUNK, 1), 0)
+            inw_chunk = ((pos_chunk >= wb).astype(jnp.int32)
+                         * (pos_chunk < wb + wc).astype(jnp.int32))
+            selL_chunk = gl_chunk * inw_chunk                # i32 0/1
+            selR_chunk = (1 - gl_chunk) * inw_chunk
+            nsub = CHUNK // T
+            # one [T, T]@[T, 2*nsub] dot: subtile s's (selL, selR) occupy
+            # columns (2s, 2s+1); a single fat matmul replaces 8 skinny ones
+            sel_stacked = jnp.concatenate(
+                [jnp.concatenate([selL_chunk[s * T:(s + 1) * T, :],
+                                  selR_chunk[s * T:(s + 1) * T, :]], axis=1)
+                 for s in range(nsub)], axis=1).astype(jnp.float32)
+            pfx16 = jax.lax.dot_general(
+                ltri[...], sel_stacked, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [T, 2*nsub]
+            tot_row = pfx16[T - 1:T, :]                      # [1, 2*nsub]
+            # interleaved per-side cumulative totals (same parity, j <= i)
+            ii16 = jax.lax.broadcasted_iota(jnp.int32, (2 * nsub, 1), 0)
+            jj16 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * nsub), 1)
+            tri16 = ((ii16 >= jj16).astype(jnp.int32)
+                     * (ii16 % 2 == jj16 % 2).astype(jnp.int32)
+                     ).astype(jnp.float32)
+            incl_row = jax.lax.dot_general(
+                tot_row, tri16, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [1, 2*nsub]
+            excl_row = incl_row - tot_row
+            totals_vm[0:1, 0:2 * nsub] = tot_row.astype(jnp.int32)
+            totals_vm[1:2, 0:2 * nsub] = incl_row.astype(jnp.int32)
+            cpt = pltpu.make_async_copy(totals_vm, totals_sm, sem_tot)
+            cpt.start()
+
+            # ---- phase B (vector, overlaps the totals DMA): place every
+            # subtile into comp_buf; dest positions are pure vector math
+            # (chunk-base fill scalars broadcast + vector exclusive bases)
+            for s in range(nsub) if "phaseB" not in dbg_skip else []:
+                selL = selL_chunk[s * T:(s + 1) * T, :]
+                selR = selR_chunk[s * T:(s + 1) * T, :]
+                pfxL = pfx16[:, 2 * s:2 * s + 1].astype(jnp.int32)
+                pfxR = pfx16[:, 2 * s + 1:2 * s + 2].astype(jnp.int32)
+                bL = excl_row[0:1, 2 * s:2 * s + 1].astype(jnp.int32)
+                bR = excl_row[0:1, 2 * s + 1:2 * s + 2].astype(jnp.int32)
+                destL = jax.lax.rem(headL + fillL + bL + pfxL - 1, TS)
+                destR = TS + jax.lax.rem(fillR + bR + pfxR - 1, TS)
                 dest = jnp.where(selL == 1, destL,
                                  jnp.where(selR == 1, destR, 2 * TS))
                 Pt = (dest == iota1x2ts).astype(jnp.bfloat16)    # [T, 2TS]
                 comp_f = jax.lax.dot_general(
-                    Pt, ti.astype(jnp.bfloat16),
+                    Pt, ti_bf[s * T:(s + 1) * T, :],
                     (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)          # [2TS, W]
-                comp = comp_f.astype(jnp.int32).astype(jnp.uint8)
+                comp_buf[s * 2 * TS:(s + 1) * 2 * TS, :] = comp_f.astype(
+                    jnp.int32).astype(jnp.uint8)
 
-                # blend the unwrapped circular ranges of both sides (masks in
-                # i32: Mosaic cannot truncate i8 bool vectors to i1)
-                pL = iota2ts
-                pR = iota2ts - TS
-                mask_u = jnp.where(
-                    iota2ts < TS,
-                    (pL >= startL).astype(jnp.int32)
-                    * (pL < startL + nls).astype(jnp.int32),
-                    (pR >= startR).astype(jnp.int32)
-                    * (pR < startR + nrs).astype(jnp.int32))
-                stage[...] = jnp.where(mask_u == 1, comp, stage[...])
-
-                crossL = startL + nls >= TS
-                crossR = startR + nrs >= TS
-
-                @pl.when(crossL)
-                def _flush_left():
-                    cpf = pltpu.make_async_copy(
-                        stage.at[pl.ds(0, TS)],
-                        rows_ref.at[pl.ds(
-                            pl.multiple_of(wb_al + nfL * TS, _ALIGN), TS)],
-                        sem_fl)
-                    cpf.start()
-                    cpf.wait()
-
-                @pl.when(crossR)
-                def _flush_right():
-                    cpf = pltpu.make_async_copy(
-                        stage.at[pl.ds(TS, TS)],
-                        scratch_ref.at[pl.ds(
-                            pl.multiple_of(nfR * TS, _ALIGN), TS)],
-                        sem_fr)
-                    cpf.start()
-                    cpf.wait()
-
-                # wrapped parts land in the freshly flushed tile
-                mask_w = jnp.where(
-                    iota2ts < TS,
-                    (pL < startL + nls - TS).astype(jnp.int32),
-                    (pR < startR + nrs - TS).astype(jnp.int32))
-                stage[...] = jnp.where(mask_w == 1, comp, stage[...])
-
-                # smaller child's histogram from the same tile
-                sf = jnp.where(hist_left == 1, selL.astype(jnp.float32),
-                               selR.astype(jnp.float32))
-                g = _f32_at(ti, voff) * sf
-                h = _f32_at(ti, voff + 4) * sf
-                vals = jnp.concatenate([g, h], axis=1)           # [T, 2]
+            # smaller child's histogram, one pass over the whole chunk
+            # (also overlaps the totals DMA)
+            if "hist" not in dbg_skip:
+                sf = jnp.where(hist_left == 1,
+                               selL_chunk.astype(jnp.float32),
+                               selR_chunk.astype(jnp.float32))
+                g = g_chunk * sf
+                h = h_chunk * sf
+                vals = jnp.concatenate([g, h], axis=1)       # [CHUNK, 2]
                 v4 = _hilo_split(vals, axis=1, exact=exact)
 
                 def colf(f):
                     if packed:
-                        return (ti[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
+                        return (ti_chunk[:, f // 2:f // 2 + 1]
+                                >> (4 * (f % 2))) & 15
                     if bpc == 2:
-                        return (ti[:, 2 * f:2 * f + 1]
-                                | (ti[:, 2 * f + 1:2 * f + 2] << 8))
-                    return ti[:, f:f + 1]
+                        return (ti_chunk[:, 2 * f:2 * f + 1]
+                                | (ti_chunk[:, 2 * f + 1:2 * f + 2] << 8))
+                    return ti_chunk[:, f:f + 1]
 
                 _accum_onehot_tiles(colf, v4, hist_ref,
                                     num_features=num_features,
                                     num_bins=num_bins, contract_dim=0)
 
-                fillL = fillL + nls
-                fillR = fillR + nrs
-                nfL = nfL + jnp.where(crossL, 1, 0)
-                nfR = nfR + jnp.where(crossR, 1, 0)
-            return fillL, fillR, nfL, nfR
+            # ---- phase C (scalar-cheap): blends + flushes from SMEM totals
+            cpt.wait()
+            accL = fillL + totals_sm[1, 2 * nsub - 2]
+            accR = fillR + totals_sm[1, 2 * nsub - 1]
+            k1L = (headL + accL) // TS       # stream tiles complete after c
+            k1R = accR // TS
+
+            # await ring slots this chunk will reuse (flushes older than NB)
+            if "flush" not in dbg_skip:
+                wdL = jax.lax.fori_loop(
+                    wdL, jnp.maximum(wdL, k1L - NB + 1),
+                    lambda m, w: (wait_left(m), w + 1)[1], wdL)
+                wdR = jax.lax.fori_loop(
+                    wdR, jnp.maximum(wdR, k1R - NB + 1),
+                    lambda m, w: (wait_right(m), w + 1)[1], wdR)
+
+            for s in range(nsub) if "phaseC" not in dbg_skip else []:
+                compL = comp_buf[s * 2 * TS:s * 2 * TS + TS, :]
+                compR = comp_buf[s * 2 * TS + TS:(s + 1) * 2 * TS, :]
+                nls = totals_sm[0, 2 * s]
+                nrs = totals_sm[0, 2 * s + 1]
+                baseL = fillL + totals_sm[1, 2 * s] - nls
+                baseR = fillR + totals_sm[1, 2 * s + 1] - nrs
+                startL = jax.lax.rem(headL + baseL, TS)
+                startR = jax.lax.rem(baseR, TS)
+                curL = jax.lax.rem((headL + baseL) // TS, NB)
+                nxtL = jax.lax.rem((headL + baseL) // TS + 1, NB)
+                curR = NB + jax.lax.rem(baseR // TS, NB)
+                nxtR = NB + jax.lax.rem(baseR // TS + 1, NB)
+
+                # blend the unwrapped circular ranges (masks in i32: Mosaic
+                # cannot truncate i8 bool vectors to i1)
+                maskLu = ((iota_ts >= startL).astype(jnp.int32)
+                          * (iota_ts < startL + nls).astype(jnp.int32))
+                stage[curL, :, :] = jnp.where(maskLu == 1, compL,
+                                              stage[curL, :, :])
+                maskRu = ((iota_ts >= startR).astype(jnp.int32)
+                          * (iota_ts < startR + nrs).astype(jnp.int32))
+                stage[curR, :, :] = jnp.where(maskRu == 1, compR,
+                                              stage[curR, :, :])
+
+                @pl.when(startL + nls > TS)
+                def _wrap_left():
+                    maskLw = (iota_ts < startL + nls - TS).astype(jnp.int32)
+                    stage[nxtL, :, :] = jnp.where(maskLw == 1, compL,
+                                                  stage[nxtL, :, :])
+
+                @pl.when(startR + nrs > TS)
+                def _wrap_right():
+                    maskRw = (iota_ts < startR + nrs - TS).astype(jnp.int32)
+                    stage[nxtR, :, :] = jnp.where(maskRw == 1, compR,
+                                                  stage[nxtR, :, :])
+
+            # start this chunk's completed-tile flushes (scalar-only loops)
+            def start_left(m, _):
+                sl = jax.lax.rem(m, NB)
+                pltpu.make_async_copy(
+                    stage.at[sl], rows_ref.at[pl.ds(left_dst(m), TS)],
+                    sem_fl.at[sl]).start()
+                return 0
+
+            def start_right(m, _):
+                sl = jax.lax.rem(m, NB)
+                pltpu.make_async_copy(
+                    stage.at[NB + sl],
+                    scratch_ref.at[pl.ds(pl.multiple_of(m * TS, _ALIGN), TS)],
+                    sem_fr.at[sl]).start()
+                return 0
+
+            if "flush" not in dbg_skip:
+                jax.lax.fori_loop(nfL, k1L, start_left, 0)
+                jax.lax.fori_loop(nfR, k1R, start_right, 0)
+
+            return accL, accR, k1L, k1R, wdL, wdR
 
         zero = jnp.int32(0)
-        fillL, fillR, nfL, nfR = jax.lax.fori_loop(
-            0, nchunks, chunk_body, (zero, zero, zero, zero))
+        fillL, fillR, nfL, nfR, wdL, wdR = jax.lax.fori_loop(
+            0, nchunks, chunk_body, (zero, zero, zero, zero, zero, zero))
         nl = fillL
         nr = fillR
         stats_ref[0, 0] = nl
+
+        # drain the outstanding async flushes
+        if "flush" not in dbg_skip:
+            jax.lax.fori_loop(wdL, nfL,
+                              lambda m, w: (wait_left(m), w + 1)[1], wdL)
+            jax.lax.fori_loop(wdR, nfR,
+                              lambda m, w: (wait_right(m), w + 1)[1], wdR)
 
         # ---- final right partial flush (scratch is all ours: no RMW,
         # garbage tail rows are masked by nr during copy-back) ----
@@ -289,9 +398,9 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         @pl.when(pend_r > 0)
         def _final_right():
             cpf = pltpu.make_async_copy(
-                stage.at[pl.ds(TS, TS)],
+                stage.at[NB + jax.lax.rem(nfR, NB)],
                 scratch_ref.at[pl.ds(pl.multiple_of(nfR * TS, _ALIGN), TS)],
-                sem_fr)
+                sem_pre)
             cpf.start()
             cpf.wait()
 
@@ -300,19 +409,23 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
         @pl.when(pend_l > 0)
         def _final_left():
-            src = pl.multiple_of(wb_al + nfL * TS, _ALIGN)
+            src = left_dst(nfL)
             cpa = pltpu.make_async_copy(rows_ref.at[pl.ds(src, TS)],
-                                        tmp, sem_fl)
+                                        tmp.at[0], sem_pre)
             cpa.start()
             cpa.wait()
-            keep = jax.lax.broadcasted_iota(jnp.int32, (TS, 1), 0) < pend_l
-            tmp[...] = jnp.where(keep, stage[0:TS, :], tmp[...])
-            cpb = pltpu.make_async_copy(tmp, rows_ref.at[pl.ds(src, TS)],
-                                        sem_fl)
+            keep = iota_ts < pend_l
+            tmp[0, :, :] = jnp.where(keep, stage[jax.lax.rem(nfL, NB), :, :],
+                                     tmp[0, :, :])
+            cpb = pltpu.make_async_copy(tmp.at[0], rows_ref.at[pl.ds(src, TS)],
+                                        sem_pre)
             cpb.start()
             cpb.wait()
 
         # ---- copy right block back: scratch[0:nr] -> rows[wb+nl ...) ----
+        # Same streamed-append machinery (double-buffered reads, NB-deep
+        # async flush ring on the left slots), with a constant row rotation
+        # by the destination's 32-row phase.
         @pl.when(nr > 0)
         def _copy_back():
             d0 = wb + nl
@@ -326,63 +439,94 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             # head prefill: keep rows [d_al, d0) (tail of the left block)
             cph = pltpu.make_async_copy(
                 rows_ref.at[pl.ds(d_al, _ALIGN)],
-                stage.at[pl.ds(0, _ALIGN)], sem_pre)
+                stage.at[0, pl.ds(0, _ALIGN)], sem_pre)
             cph.start()
             cph.wait()
             ncb = (nr + TS - 1) // TS
-            iota_ts = jax.lax.broadcasted_iota(jnp.int32, (TS, 1), 0)
+
+            pltpu.make_async_copy(
+                scratch_ref.at[pl.ds(0, TS)], tmp.at[0], sem_in.at[0]).start()
 
             def cb_body(k, carry):
                 fill, nf = carry
-                cpi = pltpu.make_async_copy(
-                    scratch_ref.at[pl.ds(
-                        pl.multiple_of(k * TS, _ALIGN), TS)],
-                    tmp, sem_cb)
-                cpi.start()
-                cpi.wait()
+                slot = jax.lax.rem(k, 2)
+                pltpu.make_async_copy(
+                    scratch_ref.at[pl.ds(pl.multiple_of(k * TS, _ALIGN), TS)],
+                    tmp.at[slot], sem_in.at[slot]).wait()
+
+                @pl.when(k + 1 < ncb)
+                def _prefetch_cb():
+                    nxt_in = 1 - slot
+                    pltpu.make_async_copy(
+                        scratch_ref.at[pl.ds(
+                            pl.multiple_of((k + 1) * TS, _ALIGN), TS)],
+                        tmp.at[nxt_in], sem_in.at[nxt_in]).start()
+
                 tr = jax.lax.dot_general(
-                    rot[...], tmp[...].astype(jnp.int32).astype(jnp.bfloat16),
+                    rot[...],
+                    tmp[slot, :, :].astype(jnp.int32).astype(jnp.bfloat16),
                     (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
                 comp = tr.astype(jnp.int32).astype(jnp.uint8)    # [TS, W]
                 nvs = jnp.minimum(nr - k * TS, TS)
-                start = jax.lax.rem(ph + fill, TS)               # == ph
                 # valid source rows j < nvs sit at p=(ph+j)%TS
                 pj = jax.lax.rem(iota_ts - ph + TS, TS)          # j of pos p
-                mask_u = ((iota_ts >= start).astype(jnp.int32)
+                cur = jax.lax.rem(nf, NB)
+                nxt = jax.lax.rem(nf + 1, NB)
+                mask_u = ((iota_ts >= ph).astype(jnp.int32)
                           * (pj < nvs).astype(jnp.int32))
-                stage[0:TS, :] = jnp.where(mask_u == 1, comp, stage[0:TS, :])
-                cross = start + nvs >= TS
+                stage[cur, :, :] = jnp.where(mask_u == 1, comp,
+                                             stage[cur, :, :])
+                cross = ph + nvs >= TS
 
                 @pl.when(cross)
                 def _flush_cb():
-                    cpf = pltpu.make_async_copy(
-                        stage.at[pl.ds(0, TS)],
+                    @pl.when(nf >= NB - 1)
+                    def _await_prev():
+                        pltpu.make_async_copy(
+                            stage.at[nxt],
+                            rows_ref.at[pl.ds(pl.multiple_of(
+                                d_al + (nf - (NB - 1)) * TS, _ALIGN), TS)],
+                            sem_cb.at[nxt]).wait()
+                    pltpu.make_async_copy(
+                        stage.at[cur],
                         rows_ref.at[pl.ds(
                             pl.multiple_of(d_al + nf * TS, _ALIGN), TS)],
-                        sem_cb)
-                    cpf.start()
-                    cpf.wait()
+                        sem_cb.at[cur]).start()
+                    mask_w = ((iota_ts < ph).astype(jnp.int32)
+                              * (pj < nvs).astype(jnp.int32))
+                    stage[nxt, :, :] = jnp.where(mask_w == 1, comp,
+                                                 stage[nxt, :, :])
 
-                mask_w = ((iota_ts < start).astype(jnp.int32)
-                          * (pj < nvs).astype(jnp.int32))
-                stage[0:TS, :] = jnp.where(mask_w == 1, comp, stage[0:TS, :])
                 return fill + nvs, nf + jnp.where(cross, 1, 0)
 
             fill, nf = jax.lax.fori_loop(0, ncb, cb_body, (zero, zero))
+            for j in range(1, NB):
+                @pl.when(nf - j >= 0)
+                def _drain_cb(j=j):
+                    idx = nf - j
+                    sl = jax.lax.rem(idx, NB)
+                    pltpu.make_async_copy(
+                        stage.at[sl],
+                        rows_ref.at[pl.ds(pl.multiple_of(
+                            d_al + idx * TS, _ALIGN), TS)],
+                        sem_cb.at[sl]).wait()
             pend = ph + fill - nf * TS
 
             @pl.when(pend > 0)
             def _final_cb():
                 src = pl.multiple_of(d_al + nf * TS, _ALIGN)
                 cpa = pltpu.make_async_copy(rows_ref.at[pl.ds(src, TS)],
-                                            tmp, sem_cb)
+                                            tmp.at[0], sem_pre)
                 cpa.start()
                 cpa.wait()
-                keep = jax.lax.broadcasted_iota(jnp.int32, (TS, 1), 0) < pend
-                tmp[...] = jnp.where(keep, stage[0:TS, :], tmp[...])
-                cpb = pltpu.make_async_copy(tmp, rows_ref.at[pl.ds(src, TS)],
-                                            sem_cb)
+                keep = iota_ts < pend
+                tmp[0, :, :] = jnp.where(keep,
+                                         stage[jax.lax.rem(nf, NB), :, :],
+                                         tmp[0, :, :])
+                cpb = pltpu.make_async_copy(tmp.at[0],
+                                            rows_ref.at[pl.ds(src, TS)],
+                                            sem_pre)
                 cpb.start()
                 cpb.wait()
 
@@ -390,12 +534,13 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "num_features", "num_bins", "voff", "bpc", "packed", "exact", "interpret"))
+    "num_features", "num_bins", "voff", "bpc", "packed", "exact", "interpret",
+    "dbg_skip"))
 def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
                           *, num_features: int,
                           num_bins: int, voff: int, bpc: int = 1,
                           packed: bool = False, exact: bool = False,
-                          interpret: bool = False):
+                          interpret: bool = False, dbg_skip: str = ""):
     """Fused split pass over a combined row store.
 
     rows: [N_pad, W] u8 row store, N_pad a multiple of CHUNK.  CONTRACT: the
@@ -419,7 +564,7 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     lanes = f_pad * num_bins
     kernel = _make_partition_kernel(
         n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
-        voff=voff, bpc=bpc, packed=packed, exact=exact)
+        voff=voff, bpc=bpc, packed=packed, exact=exact, dbg_skip=dbg_skip)
     rows_new, _scratch, hist, nl = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -436,15 +581,19 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
             ],
             scratch_shapes=[
                 pltpu.VMEM((2, CHUNK, W), jnp.uint8),    # streamed chunks
-                pltpu.VMEM((2 * TS, W), jnp.uint8),      # L/R circular stages
+                pltpu.VMEM((2 * NB, TS, W), jnp.uint8),  # L/R flush rings
                 pltpu.VMEM((T, T), jnp.bfloat16),        # lower-tri ones
                 pltpu.VMEM((TS, TS), jnp.bfloat16),      # copy-back rotation
-                pltpu.VMEM((TS, W), jnp.uint8),          # RMW bounce
-                pltpu.SemaphoreType.DMA((2,)),           # chunk reads
-                pltpu.SemaphoreType.DMA,                 # prefills
-                pltpu.SemaphoreType.DMA,                 # left flushes
-                pltpu.SemaphoreType.DMA,                 # right flushes
-                pltpu.SemaphoreType.DMA,                 # copy-back
+                pltpu.VMEM((2, TS, W), jnp.uint8),       # RMW/cb-read bounce
+                pltpu.VMEM((2 * TS * (CHUNK // T), W), jnp.uint8),  # placed
+                pltpu.VMEM((2, 128), jnp.int32),         # subtile totals
+                pltpu.SMEM((2, 128), jnp.int32),         # totals landing
+                pltpu.SemaphoreType.DMA((2,)),           # chunk/cb reads
+                pltpu.SemaphoreType.DMA,                 # prefills + finals
+                pltpu.SemaphoreType.DMA((NB,)),          # left flush ring
+                pltpu.SemaphoreType.DMA((NB,)),          # right flush ring
+                pltpu.SemaphoreType.DMA((NB,)),          # copy-back ring
+                pltpu.SemaphoreType.DMA,                 # totals VMEM->SMEM
             ],
         ),
         out_shape=[

@@ -32,7 +32,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .histogram import (build_histogram, histogram_rows, pack_nibbles,
-                        partition_buckets, _pad_bins, _pad_bins_pow2)
+                        partition_buckets, _exact_hist, _pad_bins,
+                        _pad_bins_pow2)
+from .partition import (CHUNK as _PCHUNK, fold_hist, partition_hist_pallas)
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, per_feature_best_combined,
                     reduce_feature_best, sync_best, K_MIN_SCORE)
@@ -429,12 +431,28 @@ def _ffill_nonzero(x: jax.Array) -> jax.Array:
     return x
 
 
+def _ffill_pair(flag: jax.Array, val: jax.Array):
+    """Forward-fill (flag, val) pairs: positions with flag==0 take the last
+    flagged value.  Lets the carried-mode score update spread each window's
+    leaf value across its rows WITHOUT a per-row gather (log-doubling,
+    ~20 vector passes instead of ~8 ns/row of gather descriptors)."""
+    n = flag.shape[0]
+    shift = 1
+    while shift < n:
+        fsh = jnp.concatenate([jnp.zeros((shift,), flag.dtype), flag[:-shift]])
+        vsh = jnp.concatenate([jnp.zeros((shift,), val.dtype), val[:-shift]])
+        val = jnp.where(flag > 0, val, vsh)
+        flag = jnp.where(flag > 0, flag, fsh)
+        shift *= 2
+    return flag, val
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "params", "num_bins",
                      "use_pallas", "has_categorical", "has_monotone",
                      "feat_num_bins", "packed_cols", "axis_name",
-                     "comm_mode", "num_shards"))
+                     "comm_mode", "num_shards", "carried"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -448,7 +466,9 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            packed_cols: int = 0,
                            axis_name: str = "",
                            comm_mode: str = "psum",
-                           num_shards: int = 1):
+                           num_shards: int = 1,
+                           carried: bool = False,
+                           rows_carry=None, extra=None, score_rate=None):
     """Leaf-wise growth with per-leaf physical row partitions.
 
     The TPU counterpart of the reference's ``DataPartition``
@@ -502,30 +522,68 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # bit per (row, feature), carried as extra bytes IN the row store so the
     # partition moves them for free
     lazy_on = cegb is not None and cegb[3] is not None
-    bitoff = voff + 12
+    assert not (carried and lazy_on), \
+        "carried row-store training and lazy CEGB are mutually exclusive"
+    # carried mode appends two f32 columns after the order: the objective's
+    # per-row aux value and the running score — the whole boosting state then
+    # rides the partition permutation and no per-row gather/scatter is needed
+    # between iterations (see ObjectiveFunction.carry_aux)
+    aoff = voff + 12
+    soff = voff + 16
+    bitoff = voff + (20 if carried else 12)
     bitbytes = -(-f // 8) if lazy_on else 0
     W = -(-(bitoff + bitbytes) // 128) * 128
-    if bpc == 2:
-        bins_u8 = jax.lax.bitcast_convert_type(
-            bins, jnp.uint8).reshape(n, nbytes_bins)
+    # The fused Pallas split pass (partition_hist_pallas) replaces the
+    # bucketed-switch partition on TPU: window contract requires a spare
+    # CHUNK of rows past every window end, appended with valid unique
+    # order bytes so the final row_leaf reconstruction scatter stays 1:1.
+    fused = use_pallas and not lazy_on and n % _PCHUNK == 0
+    if rows_carry is not None:
+        # boosting state already lives (permuted) in the store; refresh only
+        # the gradient/hessian bytes for this iteration
+        n_arr = n + (_PCHUNK if fused else 0)
+        assert rows_carry.shape == (n_arr, W), \
+            f"carried row store shape {rows_carry.shape} != {(n_arr, W)}"
+        gb = jax.lax.bitcast_convert_type(grad.astype(f32), jnp.uint8)
+        hb = jax.lax.bitcast_convert_type(hess.astype(f32), jnp.uint8)
+        ghb = jnp.concatenate([gb, hb], axis=1)
+        if n_arr > n:
+            ghb = jnp.pad(ghb, ((0, n_arr - n), (0, 0)))
+        rows0 = rows_carry.at[:, voff:voff + 8].set(ghb)
     else:
-        bins_u8 = bins.astype(jnp.uint8)
-    parts = [bins_u8]
-    if voff > nbytes_bins:
-        parts.append(jnp.zeros((n, voff - nbytes_bins), jnp.uint8))
-    parts.append(jax.lax.bitcast_convert_type(grad.astype(f32), jnp.uint8))
-    parts.append(jax.lax.bitcast_convert_type(hess.astype(f32), jnp.uint8))
-    parts.append(jax.lax.bitcast_convert_type(
-        jnp.arange(n, dtype=jnp.int32), jnp.uint8))
-    if lazy_on:
-        # rows that already paid lazy feature costs in EARLIER trees
-        # (feature_used_in_data_ lives for the whole training,
-        # cost_effective_gradient_boosting.hpp:47)
-        parts.append(paid_bits if paid_bits is not None
-                     else jnp.zeros((n, bitbytes), jnp.uint8))
-    if W > bitoff + bitbytes:
-        parts.append(jnp.zeros((n, W - bitoff - bitbytes), jnp.uint8))
-    rows0 = jnp.concatenate(parts, axis=1)
+        if bpc == 2:
+            bins_u8 = jax.lax.bitcast_convert_type(
+                bins, jnp.uint8).reshape(n, nbytes_bins)
+        else:
+            bins_u8 = bins.astype(jnp.uint8)
+        parts = [bins_u8]
+        if voff > nbytes_bins:
+            parts.append(jnp.zeros((n, voff - nbytes_bins), jnp.uint8))
+        parts.append(jax.lax.bitcast_convert_type(grad.astype(f32), jnp.uint8))
+        parts.append(jax.lax.bitcast_convert_type(hess.astype(f32), jnp.uint8))
+        parts.append(jax.lax.bitcast_convert_type(
+            jnp.arange(n, dtype=jnp.int32), jnp.uint8))
+        if carried:
+            aux0, score0 = extra
+            parts.append(jax.lax.bitcast_convert_type(
+                aux0.astype(f32), jnp.uint8))
+            parts.append(jax.lax.bitcast_convert_type(
+                score0.astype(f32), jnp.uint8))
+        if lazy_on:
+            # rows that already paid lazy feature costs in EARLIER trees
+            # (feature_used_in_data_ lives for the whole training,
+            # cost_effective_gradient_boosting.hpp:47)
+            parts.append(paid_bits if paid_bits is not None
+                         else jnp.zeros((n, bitbytes), jnp.uint8))
+        if W > bitoff + bitbytes:
+            parts.append(jnp.zeros((n, W - bitoff - bitbytes), jnp.uint8))
+        rows0 = jnp.concatenate(parts, axis=1)
+        if fused:
+            pad_order = jax.lax.bitcast_convert_type(
+                jnp.arange(n, n + _PCHUNK, dtype=jnp.int32), jnp.uint8)
+            pad_block = jnp.zeros((_PCHUNK, W), jnp.uint8).at[
+                :, voff + 8:voff + 12].set(pad_order)
+            rows0 = jnp.concatenate([rows0, pad_block], axis=0)
 
     def hist_rows(rows_mat, start, count):
         return histogram_rows(rows_mat, num_bins, start, count,
@@ -729,7 +787,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         return branch
 
-    branches = [make_branch(R) for R in buckets]
+    branches = [] if fused else [make_branch(R) for R in buckets]
 
     # ---- root ----
     hist0 = hist_rows(rows0, jnp.int32(0), jnp.int32(n))
@@ -826,16 +884,49 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         wb = jnp.where(ok, st.begin[leaf], 0)
         wc = jnp.where(ok, st.wcount[leaf], 0)
         left_smaller = b.left_count <= b.right_count
-        which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
-        branch_out = jax.lax.switch(
-            which, branches, st.rows, wb, wc,
-            b.feature, b.threshold, b.default_left,
-            feat.is_categorical[b.feature], b.cat_bitset, left_smaller)
-        if lazy_on:
-            rows_new, hist_small, nl, used_l, used_r = branch_out
-        else:
-            rows_new, hist_small, nl = branch_out
+        if fused:
+            # one fused Pallas pass: route + stable partition + smaller-child
+            # histogram, cost proportional to the window (core/partition.py)
+            fid = b.feature
+            if feat.offset is None:
+                unf = jnp.int32(0)
+                eoff = jnp.int32(0)
+            else:
+                unf = jnp.int32(1)
+                eoff = feat.offset[fid].astype(jnp.int32)
+            head = jnp.stack([
+                wb, wc, _feature_column(fid, feat).astype(jnp.int32),
+                b.threshold.astype(jnp.int32),
+                b.default_left.astype(jnp.int32),
+                feat.missing_type[fid].astype(jnp.int32),
+                feat.num_bin[fid].astype(jnp.int32),
+                feat.default_bin[fid].astype(jnp.int32),
+                feat.is_categorical[fid].astype(jnp.int32),
+                left_smaller.astype(jnp.int32), unf, eoff])
+            nw = num_bins // 32
+            bw = jax.lax.bitcast_convert_type(b.cat_bitset, jnp.int32)
+            if bw.shape[0] < nw:
+                bw = jnp.concatenate(
+                    [bw, jnp.zeros((nw - bw.shape[0],), jnp.int32)])
+            scal = jnp.concatenate([head, bw[:nw]])
+            rows_new, hist4, nl_arr = partition_hist_pallas(
+                st.rows, scal, num_features=f_cols, num_bins=num_bins,
+                voff=voff, bpc=bpc, packed=bool(packed_cols),
+                exact=_exact_hist())
+            hist_small = fold_hist(hist4, f_cols, num_bins)
+            nl = nl_arr[0, 0]
             used_l = used_r = jnp.zeros((f,), f32)
+        else:
+            which = jnp.searchsorted(bsizes, wc).astype(jnp.int32)
+            branch_out = jax.lax.switch(
+                which, branches, st.rows, wb, wc,
+                b.feature, b.threshold, b.default_left,
+                feat.is_categorical[b.feature], b.cat_bitset, left_smaller)
+            if lazy_on:
+                rows_new, hist_small, nl, used_l, used_r = branch_out
+            else:
+                rows_new, hist_small, nl = branch_out
+                used_l = used_r = jnp.zeros((f,), f32)
         if axis_name:
             # per-split Allreduce (psum) or ReduceScatter (rs) of the
             # smaller child's histogram
@@ -997,16 +1088,35 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         state = jax.lax.fori_loop(1, L, body, state)
 
     # reconstruct per-row leaf assignment from the windows + permutation
+    # (n_arr covers the fused path's spare CHUNK; those rows sit past every
+    # window, pick up a garbage leaf id, and are sliced away)
     t = state.tree
-    order = jax.lax.bitcast_convert_type(
-        state.rows[:, voff + 8:voff + 12], jnp.int32).reshape(n)
+    n_arr = state.rows.shape[0]
     valid = (jnp.arange(L) < t.num_leaves) & (state.wcount > 0)
-    marks = jnp.zeros((n,), jnp.int32).at[
-        jnp.where(valid, state.begin, n)].set(
+    mark_pos = jnp.where(valid, state.begin, n_arr)
+    marks = jnp.zeros((n_arr,), jnp.int32).at[mark_pos].set(
         jnp.arange(L, dtype=jnp.int32) + 1, mode="drop")
+    if carried:
+        # The score column is updated in place by forward-filling each
+        # window's (shrinkage-scaled) leaf value — no per-row gather/scatter.
+        # row_leaf is returned EMPTY: the permuted-order assignment would
+        # corrupt original-order consumers (rollback, stall trim), which
+        # route the tree over the bins instead (gbdt._gather_tree_output).
+        lv = t.leaf_value * score_rate
+        vmarks = jnp.zeros((n_arr,), f32).at[mark_pos].set(lv, mode="drop")
+        _, leaf_val_pos = _ffill_pair(marks, vmarks)
+        score_old = jax.lax.bitcast_convert_type(
+            state.rows[:, soff:soff + 4], jnp.int32).reshape(n_arr)
+        score_new = (jax.lax.bitcast_convert_type(score_old, f32)
+                     + leaf_val_pos)
+        rows_out = state.rows.at[:, soff:soff + 4].set(
+            jax.lax.bitcast_convert_type(score_new, jnp.uint8))
+        return t._replace(row_leaf=jnp.zeros((0,), jnp.int32)), rows_out
     leaf_of_pos = _ffill_nonzero(marks) - 1
-    row_leaf = jnp.zeros((n,), jnp.int32).at[order].set(
-        leaf_of_pos, unique_indices=True)
+    order = jax.lax.bitcast_convert_type(
+        state.rows[:, voff + 8:voff + 12], jnp.int32).reshape(n_arr)
+    row_leaf = jnp.zeros((n_arr,), jnp.int32).at[order].set(
+        leaf_of_pos, unique_indices=True)[:n]
     arrays = t._replace(row_leaf=row_leaf)
     if lazy_on:
         # paid-bit state back in ORIGINAL row order for the next tree
@@ -1256,6 +1366,17 @@ class SerialTreeLearner:
             return
         valid = jnp.arange(self.num_leaves) < (arrays.num_leaves - 1)
         self.cegb_used = self.cegb_used.at[arrays.split_feature].max(valid)
+
+    def row_layout(self) -> dict:
+        """Byte offsets of the combined row store (mirrors
+        build_tree_partitioned's layout) for carried-mode consumers."""
+        bpc = 2 if self.bins.dtype == jnp.uint16 else 1
+        ncols = self.bins.shape[1]
+        voff = -(-(ncols * bpc) // 4) * 4
+        n = self.bins.shape[0]
+        fused = self.use_pallas and n % _PCHUNK == 0
+        return {"voff": voff, "aoff": voff + 12, "soff": voff + 16,
+                "n_arr": n + (_PCHUNK if fused else 0)}
 
     def route_bins_matrix(self) -> jax.Array:
         """Training bins with one column per group column (unpacked view for

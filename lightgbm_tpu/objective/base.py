@@ -53,6 +53,23 @@ class ObjectiveFunction:
         of the same shape."""
         raise NotImplementedError
 
+    # ---- carried-row-store training (boosting/gbdt.py fused path) ----
+    # Objectives whose gradients are a pointwise function of (score, one f32
+    # per-row auxiliary value) can train with the per-row state carried INSIDE
+    # the tree builder's permuted row store, eliminating every per-row
+    # gather/scatter between iterations.  ``carry_aux`` returns that [N] f32
+    # auxiliary vector (or None when unsupported — e.g. ranking objectives
+    # whose gradients need query-grouped neighbours, or when sample weights
+    # would need a second column).
+
+    def carry_aux(self):
+        return None
+
+    def pointwise_gradients(self, score, aux):
+        """grad/hess of a single row given its score and carried aux value;
+        must be vectorized over [N] arrays and ORDER-AGNOSTIC."""
+        raise NotImplementedError
+
     def boost_from_score(self, class_id: int = 0) -> float:
         return 0.0
 

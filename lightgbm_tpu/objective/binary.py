@@ -62,6 +62,19 @@ class BinaryLogloss(ObjectiveFunction):
         hess = abs_resp * (self.sigmoid - abs_resp) * self._label_weight
         return self._apply_weights(grad, hess)
 
+    def carry_aux(self):
+        if not self.need_train or self.weights is not None:
+            return None
+        # sign carries y, magnitude carries the class re-weighting
+        return self._yval * self._label_weight
+
+    def pointwise_gradients(self, score, aux):
+        y = jnp.sign(aux)
+        lw = jnp.abs(aux)
+        response = -y * self.sigmoid / (1.0 + jnp.exp(y * self.sigmoid * score))
+        abs_resp = jnp.abs(response)
+        return response * lw, abs_resp * (self.sigmoid - abs_resp) * lw
+
     def boost_from_score(self, class_id: int = 0) -> float:
         pos = self._is_pos(self.label_np).astype(np.float64)
         if self.weights_np is not None:

@@ -36,6 +36,14 @@ class RegressionL2Loss(ObjectiveFunction):
         hess = jnp.ones_like(score)
         return self._apply_weights(grad, hess)
 
+    def carry_aux(self):
+        if type(self) is not RegressionL2Loss or self.weights is not None:
+            return None
+        return self.label
+
+    def pointwise_gradients(self, score, aux):
+        return score - aux, jnp.ones_like(score)
+
     def boost_from_score(self, class_id: int = 0) -> float:
         if self.weights_np is not None:
             return float(np.average(self.label_np, weights=self.weights_np))
