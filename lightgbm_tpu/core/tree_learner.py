@@ -46,28 +46,25 @@ from ..io.dataset import BinnedDataset
 class Comm(NamedTuple):
     """Static collective-communication strategy for multi-chip tree growth.
 
-    Replaces the reference's ``Network`` singleton calls (SURVEY.md §2.3) with XLA
-    collectives inside the compiled tree build; ``build_tree`` is run under
-    ``jax.shard_map`` over a mesh axis named ``axis_name``:
+    Replaces the reference's ``Network`` singleton calls (SURVEY.md §2.3) with
+    XLA collectives inside the compiled tree build; every parallel learner
+    composes over :func:`build_tree_partitioned` (``comm_mode`` below), the
+    same way the reference composes its parallel learners over the serial
+    base via templates (tree_learner.cpp:24-33):
 
-    - ``serial``: single shard, no collectives.
-    - ``data_psum``: rows sharded; global histograms via ``psum`` (simple data
-      parallel — every shard scans all features).
-    - ``data_rs``: rows sharded; ``psum_scatter`` shards the *global* histogram
+    - ``rs``: rows sharded; ``psum_scatter`` shards the *global* histogram
       over features so each chip scans only F/d features, then an
       allreduce-argmax of the per-shard bests — the exact comm structure of
       ``DataParallelTreeLearner`` (data_parallel_tree_learner.cpp:149-240).
-    - ``feature``: rows replicated, histogram work sharded over features
+    - ``psum``: rows sharded; full-histogram allreduce per split.
+    - ``feature``: rows replicated, scan sharded over features
       (feature_parallel_tree_learner.cpp:33-71); only the tiny best-split
       allreduce crosses chips.
-    - ``voting``: rows sharded; per-shard top-k feature election + global vote,
-      then psum of only the elected features' histograms
+    - ``voting``: rows sharded; per-shard top-k feature election + global
+      vote, then psum of only the elected features' histograms
       (voting_parallel_tree_learner.cpp:170-366).
     """
     axis_name: str = ""
-    # serial | data_psum | data_rs | feature | voting; "data_part" tags the
-    # partitioned data-parallel learner (build_tree_partitioned + psum), which
-    # does not go through build_tree's mode dispatch
     mode: str = "serial"
     num_shards: int = 1
     top_k: int = 20
@@ -92,15 +89,6 @@ class TreeArrays(NamedTuple):
     cat_bitset: jax.Array       # [L, B//32] u32 left-bin sets (categorical)
     num_leaves: jax.Array       # scalar i32
     row_leaf: jax.Array         # [N] i32 final leaf of every row
-
-
-class _State(NamedTuple):
-    tree: TreeArrays
-    hist: jax.Array             # [L, F, 2, B]
-    bests: BestSplit            # arrays [L]
-    cont: jax.Array             # scalar bool
-    cmin: jax.Array             # [L] monotone constraint lower bounds
-    cmax: jax.Array             # [L] upper bounds
 
 
 def _bests_update(bests: BestSplit, idx, new: BestSplit) -> BestSplit:
@@ -141,255 +129,6 @@ def _route_left(col, threshold, default_left, mt, nb, dbin,
         word = jnp.take_along_axis(bitset, (col >> 5)[:, None], axis=1)[:, 0]
     cat_left = ((word >> (col & 31).astype(jnp.uint32)) & 1) == 1
     return jnp.where(is_cat, cat_left, num_left)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_leaves", "max_depth", "params", "num_bins", "use_pallas",
-                     "comm", "has_categorical", "has_monotone"))
-def build_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-               num_data: jax.Array, feature_mask: jax.Array, feat: FeatureInfo,
-               *, num_leaves: int, max_depth: int, params: SplitParams,
-               num_bins: int, use_pallas: bool = False,
-               comm: Comm = Comm(), has_categorical: bool = False,
-               has_monotone: bool = False) -> TreeArrays:
-    """Grow one tree.  grad/hess are pre-masked (bagging/subsample weights applied);
-    ``num_data`` is the GLOBAL in-bag row count.
-
-    With ``comm.mode != 'serial'`` this runs inside ``jax.shard_map``: rows (and/or
-    histogram features) are sharded over ``comm.axis_name`` and the reference's
-    three network calls per split (root-sum Allreduce, histogram ReduceScatter,
-    best-split argmax Allreduce — SURVEY.md §3.2) become XLA collectives over ICI.
-    All shards follow identical control flow, so the result is replicated."""
-    n, f = bins.shape
-    L = num_leaves
-    B = num_bins
-    f32 = jnp.float32
-    mode = comm.mode
-    d = comm.num_shards
-    ax = comm.axis_name
-    data_sharded = mode in ("data_psum", "data_rs", "voting")
-
-    if mode in ("data_rs", "feature"):
-        assert f % d == 0, "pad features to a multiple of the mesh axis size"
-        chunk = f // d
-        off = jax.lax.axis_index(ax) * chunk
-
-        def _slc(a):
-            return jax.lax.dynamic_slice_in_dim(a, off, chunk, axis=0)
-        feat_c = FeatureInfo(*[None if a is None else _slc(a)
-                              for a in feat])
-        mask_c = _slc(feature_mask)
-        ids_c = off + jnp.arange(chunk, dtype=jnp.int32)
-
-    if mode == "voting":
-        # local candidate search scales the per-leaf minimums by 1/num_machines
-        # (voting_parallel_tree_learner.cpp:57-59)
-        vote_params = params._replace(
-            min_data_in_leaf=max(params.min_data_in_leaf // d, 1),
-            min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / d)
-
-    def _reduce_hist(h):
-        if mode == "data_psum":
-            return jax.lax.psum(h, ax)
-        if mode == "data_rs":
-            return jax.lax.psum_scatter(h, ax, scatter_dimension=0, tiled=True)
-        return h  # serial, feature, voting (kept local)
-
-    def make_hist(vals):
-        """Stored-histogram block for this shard from masked [2,N] values."""
-        if mode == "feature":
-            bc = jax.lax.dynamic_slice_in_dim(bins, off, chunk, axis=1)
-            return build_histogram(bc, vals, B, use_pallas)
-        return _reduce_hist(build_histogram(bins, vals, B, use_pallas))
-
-    def make_hist_sub(values, mask_b):
-        """Histogram of the rows where mask_b (the smaller child).
-
-        Full-N masked pass: XLA row gathers cost ~10-25 ns/row on TPU (per-row
-        DMA), so physically compacting the child's rows (tried; the reference's
-        DataPartition approach, data_partition.hpp:113) LOSES to streaming all
-        rows through the one-hot-matmul kernel with zeroed values.  The win to
-        chase instead is windowed periodic repartition (sort rows by leaf once
-        per level, then the bounded kernel skips tiles outside the leaf's
-        window — see build_tree_partitioned)."""
-        return make_hist(values * mask_b.astype(f32)[None, :])
-
-    def pfb(h_, feat_, mask_, sg, sh, cnt, params_, cmn, cmx):
-        return per_feature_best_combined(
-            h_, feat_, mask_, sg, sh, cnt, params_,
-            any_categorical=has_categorical,
-            cmin=cmn if has_monotone else None,
-            cmax=cmx if has_monotone else None)
-
-    def best_of(h, sg, sh, cnt, cmn, cmx):
-        """Replicated best split from a stored block + GLOBAL leaf sums +
-        the leaf's monotone-constraint bounds."""
-        if mode in ("serial", "data_psum"):
-            fb = pfb(h, feat, feature_mask, sg, sh, cnt, params, cmn, cmx)
-            return reduce_feature_best(fb, jnp.arange(f, dtype=jnp.int32))
-        if mode in ("data_rs", "feature"):
-            fb = pfb(h, feat_c, mask_c, sg, sh, cnt, params, cmn, cmx)
-            return sync_best(reduce_feature_best(fb, ids_c), ax)
-        # voting: elect 2*top_k features globally, aggregate only those
-        local = jnp.sum(h[0], axis=-1)          # every row hits one bin of feat 0
-        lg, lh = local[0], local[1]
-        lcnt = cnt.astype(f32) * lh / (sh + 1e-15)
-        fb_local = pfb(h, feat, feature_mask, lg, lh, lcnt, vote_params,
-                       cmn, cmx)
-        k = min(comm.top_k, f)
-        top_gain, top_ids = jax.lax.top_k(fb_local.gain, k)
-        all_ids = jax.lax.all_gather(top_ids, ax).reshape(-1)
-        all_ok = jax.lax.all_gather(top_gain, ax).reshape(-1) > K_MIN_SCORE
-        votes = jax.ops.segment_sum(all_ok.astype(f32), all_ids, num_segments=f)
-        key = votes - jnp.arange(f, dtype=f32) / (f + 1.0)  # ties → smaller id
-        elected = jnp.sort(jax.lax.top_k(key, min(2 * k, f))[1]).astype(jnp.int32)
-        he = jax.lax.psum(h[elected], ax)
-        feat_e = FeatureInfo(*[None if a is None else a[elected]
-                              for a in feat])
-        fb = pfb(he, feat_e, feature_mask[elected], sg, sh, cnt, params,
-                 cmn, cmx)
-        return reduce_feature_best(fb, elected)
-
-    values = jnp.stack([grad, hess], axis=0)
-    hist0 = make_hist(values)
-    sum_g = jnp.sum(grad)
-    sum_h = jnp.sum(hess)
-    if data_sharded:
-        # root aggregate Allreduce (data_parallel_tree_learner.cpp:99-146)
-        sum_g = jax.lax.psum(sum_g, ax)
-        sum_h = jax.lax.psum(sum_h, ax)
-    no_min = jnp.float32(-np.inf)
-    no_max = jnp.float32(np.inf)
-    best0 = best_of(hist0, sum_g, sum_h, num_data, no_min, no_max)
-
-    def zl(dtype=f32):
-        return jnp.zeros((L,), dtype=dtype)
-
-    tree = TreeArrays(
-        split_feature=zl(jnp.int32), threshold_bin=zl(jnp.int32),
-        split_gain=zl(), default_left=zl(bool),
-        left_child=zl(jnp.int32), right_child=zl(jnp.int32),
-        internal_value=zl(), internal_weight=zl(), internal_count=zl(),
-        leaf_value=zl(), leaf_weight=zl().at[0].set(sum_h),
-        leaf_count=zl().at[0].set(num_data.astype(f32)),
-        leaf_parent=jnp.full((L,), -1, dtype=jnp.int32), leaf_depth=zl(jnp.int32),
-        cat_bitset=jnp.zeros((L, B // 32), dtype=jnp.uint32),
-        num_leaves=jnp.int32(1), row_leaf=jnp.zeros((n,), dtype=jnp.int32))
-
-    hist = jnp.zeros((L,) + hist0.shape, dtype=f32).at[0].set(hist0)
-    bests = BestSplit(*[jnp.broadcast_to(x, (L,) + x.shape).astype(x.dtype)
-                        for x in best0])
-    state = _State(tree=tree, hist=hist, bests=bests, cont=jnp.bool_(True),
-                   cmin=jnp.full((L,), -np.inf, dtype=f32),
-                   cmax=jnp.full((L,), np.inf, dtype=f32))
-
-    vmapped_best = jax.vmap(best_of)
-
-    def body(k, st: _State) -> _State:
-        node = k - 1
-        t = st.tree
-        gains = jnp.where(jnp.arange(L) < t.num_leaves, st.bests.gain, K_MIN_SCORE)
-        if max_depth > 0:
-            gains = jnp.where(t.leaf_depth < max_depth, gains, K_MIN_SCORE)
-        leaf = jnp.argmax(gains).astype(jnp.int32)
-        ok = (gains[leaf] > 0.0) & st.cont
-
-        def do_split(st: _State) -> _State:
-            t = st.tree
-            b = BestSplit(*[x[leaf] for x in st.bests])
-            feat_id, thr = b.feature, b.threshold
-            col = jax.lax.dynamic_index_in_dim(
-                bins, _feature_column(feat_id, feat), axis=1,
-                keepdims=False).astype(jnp.int32)
-            col = _unfold_bin(col, feat_id, feat)
-            go_left = _route_left(col, thr, b.default_left,
-                                  feat.missing_type[feat_id],
-                                  feat.num_bin[feat_id],
-                                  feat.default_bin[feat_id],
-                                  is_cat=feat.is_categorical[feat_id],
-                                  bitset=b.cat_bitset)
-            in_leaf = t.row_leaf == leaf
-            row_leaf = jnp.where(in_leaf & ~go_left, k, t.row_leaf)
-
-            # histogram for the smaller child; sibling by subtraction (:347-356)
-            left_is_smaller = b.left_count <= b.right_count
-            smaller_id = jnp.where(left_is_smaller, leaf, k)
-            hist_smaller = make_hist_sub(values, row_leaf == smaller_id)
-            hist_larger = st.hist[leaf] - hist_smaller
-            hist_left = jnp.where(left_is_smaller, hist_smaller, hist_larger)
-            hist_right = jnp.where(left_is_smaller, hist_larger, hist_smaller)
-            hist_new = st.hist.at[leaf].set(hist_left).at[k].set(hist_right)
-
-            # monotone constraint propagation
-            # (monotone_constraints.hpp UpdateConstraints)
-            pmin, pmax = st.cmin[leaf], st.cmax[leaf]
-            if has_monotone and feat.monotone is not None:
-                mono_f = feat.monotone[feat_id]
-            else:
-                mono_f = jnp.int32(0)
-            is_num = ~feat.is_categorical[feat_id]
-            mid = (b.left_output + b.right_output) * 0.5
-            lmin = jnp.where(is_num & (mono_f < 0), jnp.maximum(pmin, mid), pmin)
-            lmax = jnp.where(is_num & (mono_f > 0), jnp.minimum(pmax, mid), pmax)
-            rmin = jnp.where(is_num & (mono_f > 0), jnp.maximum(pmin, mid), pmin)
-            rmax = jnp.where(is_num & (mono_f < 0), jnp.minimum(pmax, mid), pmax)
-            cmin_new = st.cmin.at[leaf].set(lmin).at[k].set(rmin)
-            cmax_new = st.cmax.at[leaf].set(lmax).at[k].set(rmax)
-
-            child_best = vmapped_best(
-                jnp.stack([hist_left, hist_right]),
-                jnp.stack([b.left_sum_grad, b.right_sum_grad]),
-                jnp.stack([b.left_sum_hess, b.right_sum_hess]),
-                jnp.stack([b.left_count, b.right_count]),
-                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]))
-            bests = _bests_update(st.bests, leaf,
-                                  BestSplit(*[x[0] for x in child_best]))
-            bests = _bests_update(bests, k, BestSplit(*[x[1] for x in child_best]))
-
-            # parent child-pointer fixup (tree.h:338-346)
-            parent = t.leaf_parent[leaf]
-            pidx = jnp.maximum(parent, 0)
-            lc = t.left_child
-            rc = t.right_child
-            lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~leaf),
-                                           node, lc[pidx]))
-            rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~leaf),
-                                           node, rc[pidx]))
-
-            tree_new = TreeArrays(
-                split_feature=t.split_feature.at[node].set(feat_id),
-                threshold_bin=t.threshold_bin.at[node].set(thr),
-                split_gain=t.split_gain.at[node].set(b.gain),
-                default_left=t.default_left.at[node].set(b.default_left),
-                left_child=lc.at[node].set(~leaf),
-                right_child=rc.at[node].set(~k),
-                internal_value=t.internal_value.at[node].set(t.leaf_value[leaf]),
-                internal_weight=t.internal_weight.at[node].set(t.leaf_weight[leaf]),
-                internal_count=t.internal_count.at[node].set(
-                    b.left_count + b.right_count),
-                leaf_value=t.leaf_value.at[leaf].set(
-                    jnp.nan_to_num(b.left_output)).at[k].set(
-                    jnp.nan_to_num(b.right_output)),
-                leaf_weight=t.leaf_weight.at[leaf].set(
-                    b.left_sum_hess).at[k].set(b.right_sum_hess),
-                leaf_count=t.leaf_count.at[leaf].set(
-                    b.left_count).at[k].set(b.right_count),
-                leaf_parent=t.leaf_parent.at[leaf].set(node).at[k].set(node),
-                leaf_depth=t.leaf_depth.at[k].set(
-                    t.leaf_depth[leaf] + 1).at[leaf].add(1),
-                cat_bitset=t.cat_bitset.at[node].set(b.cat_bitset),
-                num_leaves=t.num_leaves + 1,
-                row_leaf=row_leaf)
-            return _State(tree=tree_new, hist=hist_new, bests=bests,
-                          cont=st.cont, cmin=cmin_new, cmax=cmax_new)
-
-        return jax.lax.cond(ok, do_split,
-                            lambda s: s._replace(cont=jnp.bool_(False)), st)
-
-    if L > 1:
-        state = jax.lax.fori_loop(1, L, body, state)
-    return state.tree
 
 
 class _PState(NamedTuple):
@@ -452,7 +191,7 @@ def _ffill_pair(flag: jax.Array, val: jax.Array):
     static_argnames=("num_leaves", "max_depth", "params", "num_bins",
                      "use_pallas", "has_categorical", "has_monotone",
                      "feat_num_bins", "packed_cols", "axis_name",
-                     "comm_mode", "num_shards", "carried"))
+                     "comm_mode", "num_shards", "carried", "top_k"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -468,6 +207,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            comm_mode: str = "psum",
                            num_shards: int = 1,
                            carried: bool = False,
+                           top_k: int = 20,
                            rows_carry=None, extra=None, score_rate=None):
     """Leaf-wise growth with per-leaf physical row partitions.
 
@@ -616,17 +356,28 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return hf.at[:, 0, 0].set(sg - rest[:, 0]).at[:, 1, 0].set(
             sh - rest[:, 1])
 
-    # reduce-scatter comm mode (the reference DataParallelTreeLearner
-    # structure, data_parallel_tree_learner.cpp:149-240): per-split ICI
-    # volume is F*B/d per shard instead of d copies of the full block, each
-    # shard stores/scans only the global histograms of its own F/d features,
-    # and the winning split is an allreduce-argmax (SyncUpGlobalBestSplit,
-    # parallel_tree_learner.h:190-213)
+    # Collective comm modes over ``axis_name`` (rows sharded unless noted):
+    # - "rs": the reference DataParallelTreeLearner structure
+    #   (data_parallel_tree_learner.cpp:149-240) — per-split ICI volume is
+    #   F*B/d per shard, each shard stores/scans only the GLOBAL histograms
+    #   of its own F/d features, winner by allreduce-argmax
+    #   (SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213)
+    # - "psum": full-histogram allreduce per split (simple data parallel)
+    # - "feature": rows REPLICATED; every shard partitions identically and
+    #   holds the full local=global histogram but scans only its own F/d
+    #   features; only the tiny best-split allreduce crosses chips
+    #   (feature_parallel_tree_learner.cpp:33-71)
+    # - "voting": rows sharded, histograms kept LOCAL; per-shard top-k
+    #   candidate election + global vote, then psum of only the 2*top_k
+    #   elected features' histograms
+    #   (voting_parallel_tree_learner.cpp:170-366)
     rs = bool(axis_name) and comm_mode == "rs"
-    if rs:
+    feat_mode = bool(axis_name) and comm_mode == "feature"
+    vote_mode = bool(axis_name) and comm_mode == "voting"
+    if rs or feat_mode:
         assert unpack_lanes is None and forced is None and cegb is None, \
-            "comm_mode='rs' shards the feature scan; EFB unpacking, forced " \
-            "splits and CEGB need the full histogram block"
+            "feature-sharded scans need one column per feature and the full " \
+            "histogram block for forced splits / CEGB"
         assert f % num_shards == 0, "pad features to a multiple of the mesh"
         chunk_f = f // num_shards
         off_f = jax.lax.axis_index(axis_name) * chunk_f
@@ -636,9 +387,21 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         feat_c = FeatureInfo(*[None if a is None else _slc(a) for a in feat])
         mask_c = _slc(feature_mask)
         ids_c = off_f + jnp.arange(chunk_f, dtype=jnp.int32)
+    if vote_mode:
+        assert unpack_lanes is None and forced is None and cegb is None, \
+            "voting elects by feature id; EFB unpacking, forced splits and " \
+            "CEGB need the full histogram block"
+        # local candidate search scales the per-leaf minimums by 1/d
+        # (voting_parallel_tree_learner.cpp:57-59)
+        vote_params = params._replace(
+            min_data_in_leaf=max(params.min_data_in_leaf // num_shards, 1),
+            min_sum_hessian_in_leaf=(params.min_sum_hessian_in_leaf
+                                     / num_shards))
 
     def reduce_hist(h):
-        if not axis_name:
+        if not axis_name or feat_mode or vote_mode:
+            # feature: rows replicated, local histogram IS global;
+            # voting: histograms stay local, only elected rows are summed
             return h
         if rs:
             return jax.lax.psum_scatter(h, axis_name, scatter_dimension=0,
@@ -649,13 +412,45 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         """Best split of a leaf; with CEGB also returns the per-feature
         candidates (the reference's splits_per_leaf_ cache,
         cost_effective_gradient_boosting.hpp:35)."""
-        if rs:
+        if rs or feat_mode:
+            hc = h if rs else jax.lax.dynamic_slice_in_dim(
+                h, off_f, chunk_f, axis=0)
             fb = per_feature_best_combined(
-                h, feat_c, mask_c, sg, sh, cnt, params,
+                hc, feat_c, mask_c, sg, sh, cnt, params,
                 any_categorical=has_categorical,
                 cmin=cmn if has_monotone else None,
                 cmax=cmx if has_monotone else None)
             return sync_best(reduce_feature_best(fb, ids_c), axis_name)
+        if vote_mode:
+            # per-shard candidate search on LOCAL histograms with scaled
+            # minimums, 2*top_k election, psum of only the elected features
+            local = jnp.sum(h[0], axis=-1)   # every row hits one bin of f0
+            lg, lh = local[0], local[1]
+            lcnt = cnt.astype(f32) * lh / (sh + 1e-15)
+            fb_local = per_feature_best_combined(
+                h, feat, feature_mask, lg, lh, lcnt, vote_params,
+                any_categorical=has_categorical,
+                cmin=cmn if has_monotone else None,
+                cmax=cmx if has_monotone else None)
+            kk = min(top_k, f)
+            top_gain, top_ids = jax.lax.top_k(fb_local.gain, kk)
+            all_ids = jax.lax.all_gather(top_ids, axis_name).reshape(-1)
+            all_ok = jax.lax.all_gather(top_gain, axis_name
+                                        ).reshape(-1) > K_MIN_SCORE
+            votes = jax.ops.segment_sum(all_ok.astype(f32), all_ids,
+                                        num_segments=f)
+            key = votes - jnp.arange(f, dtype=f32) / (f + 1.0)  # ties: low id
+            elected = jnp.sort(
+                jax.lax.top_k(key, min(2 * kk, f))[1]).astype(jnp.int32)
+            he = jax.lax.psum(h[elected], axis_name)
+            feat_e = FeatureInfo(*[None if a is None else a[elected]
+                                   for a in feat])
+            fb = per_feature_best_combined(
+                he, feat_e, feature_mask[elected], sg, sh, cnt, params,
+                any_categorical=has_categorical,
+                cmin=cmn if has_monotone else None,
+                cmax=cmx if has_monotone else None)
+            return reduce_feature_best(fb, elected)
         fb = per_feature_best_combined(
             unpack(h, sg, sh), feat, feature_mask, sg, sh, cnt, params,
             any_categorical=has_categorical,
@@ -795,10 +590,12 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     sum_h = jnp.sum(hess)
     if axis_name:
         # root aggregate + histogram Allreduce/ReduceScatter
-        # (data_parallel_tree_learner.cpp:99-146)
+        # (data_parallel_tree_learner.cpp:99-146); feature mode replicates
+        # the rows, so local sums are already global
         hist0 = reduce_hist(hist0)
-        sum_g = jax.lax.psum(sum_g, axis_name)
-        sum_h = jax.lax.psum(sum_h, axis_name)
+        if not feat_mode:
+            sum_g = jax.lax.psum(sum_g, axis_name)
+            sum_h = jax.lax.psum(sum_h, axis_name)
     no_min = jnp.float32(-np.inf)
     no_max = jnp.float32(np.inf)
     used0 = (cegb[2] if cegb is not None else jnp.zeros((f,), bool))

@@ -4,14 +4,14 @@ TPU-native counterpart of the reference's parallel tree learners and socket/MPI
 Network layer (src/treelearner/{data,feature,voting}_parallel_tree_learner.cpp,
 src/network/) — see lightgbm_tpu/core/tree_learner.py:Comm for the mapping.
 """
-from .learners import (DataParallelPsumTreeLearner, DataParallelTreeLearner,
+from .learners import (DataParallelTreeLearner,
                        FeatureParallelTreeLearner,
                        PartitionedDataParallelTreeLearner,
                        VotingParallelTreeLearner, create_tree_learner,
                        default_mesh)
 
 __all__ = [
-    "DataParallelPsumTreeLearner", "DataParallelTreeLearner",
+    "DataParallelTreeLearner",
     "FeatureParallelTreeLearner", "PartitionedDataParallelTreeLearner",
     "VotingParallelTreeLearner", "create_tree_learner", "default_mesh",
 ]

@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.split import FeatureInfo
 from ..core.tree_learner import (Comm, SerialTreeLearner, TreeArrays,
-                                 build_tree, build_tree_partitioned)
+                                 build_tree_partitioned)
 
 
 def default_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
@@ -101,14 +101,23 @@ class _ParallelTreeLearner(SerialTreeLearner):
         self.bins = jax.device_put(binned, NamedSharding(self.mesh, row_spec))
 
     # ---- compiled build ----
+    # Every parallel learner composes over the SAME partitioned base builder
+    # (the reference composes its parallel learners over the serial one via
+    # templates, tree_learner.cpp:24-33); only the comm_mode differs.
+
+    comm_mode = "rs"
 
     def _make_build_fn(self):
         fn = functools.partial(
-            build_tree, num_leaves=self.num_leaves, max_depth=self.max_depth,
-            params=self.params, num_bins=self.num_bins,
-            use_pallas=self.use_pallas, comm=self.comm,
+            build_tree_partitioned, num_leaves=self.num_leaves,
+            max_depth=self.max_depth, params=self.params,
+            num_bins=self.num_bins, use_pallas=self.use_pallas,
             has_categorical=self.has_categorical,
-            has_monotone=self.has_monotone)
+            has_monotone=self.has_monotone,
+            feat_num_bins=self.feat_bins, unpack_lanes=self.unpack_lanes,
+            packed_cols=self.packed_cols, axis_name=self.axis,
+            comm_mode=self.comm_mode, num_shards=self.num_shards,
+            top_k=int(self.comm.top_k))
         row = P() if self.mode == "feature" else P(self.axis)
         bins_spec = P() if self.mode == "feature" else P(self.axis, None)
         out_specs = TreeArrays(
@@ -150,25 +159,7 @@ class DataParallelTreeLearner(_ParallelTreeLearner):
     ICI volume is F*B*16/d bytes per chip and the stored histogram state is
     [L, F/d, 2, B]."""
     mode = "data_rs"
-
-    def _make_build_fn(self):
-        fn = functools.partial(
-            build_tree_partitioned, num_leaves=self.num_leaves,
-            max_depth=self.max_depth, params=self.params,
-            num_bins=self.num_bins, use_pallas=self.use_pallas,
-            has_categorical=self.has_categorical,
-            has_monotone=self.has_monotone,
-            feat_num_bins=self.feat_bins, unpack_lanes=self.unpack_lanes,
-            packed_cols=self.packed_cols, axis_name=self.axis,
-            comm_mode="rs", num_shards=self.num_shards)
-        row = P(self.axis)
-        out_specs = TreeArrays(
-            *([P()] * len(TreeArrays._fields)))._replace(row_leaf=row)
-        shard_fn = jax.shard_map(
-            fn, mesh=self.mesh,
-            in_specs=(P(self.axis, None), row, row, P(), P(), P()),
-            out_specs=out_specs, check_vma=False)
-        return jax.jit(shard_fn)
+    comm_mode = "rs"
 
 
 class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
@@ -239,21 +230,22 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
         return arrays
 
 
-class DataParallelPsumTreeLearner(_ParallelTreeLearner):
-    """Data parallel with full-histogram psum: every shard scans all features
-    of the legacy full-stream builder (kept for comparison; tree_learner=data
-    uses the partitioned psum learner)."""
-    mode = "data_psum"
-
-
 class FeatureParallelTreeLearner(_ParallelTreeLearner):
-    """tree_learner=feature: replicated data, feature-sharded histogram work."""
+    """tree_learner=feature: replicated data on every shard, scan sharded
+    over features, one best-split allreduce per split
+    (feature_parallel_tree_learner.cpp:33-71).  Runs the partitioned base
+    builder like every other learner."""
     mode = "feature"
+    comm_mode = "feature"
 
 
 class VotingParallelTreeLearner(_ParallelTreeLearner):
-    """tree_learner=voting: rows sharded, top-k feature election."""
+    """tree_learner=voting: rows sharded, histograms local, per-split 2*top_k
+    feature election + psum of only the elected features' histograms
+    (voting_parallel_tree_learner.cpp:170-366).  Runs the partitioned base
+    builder like every other learner."""
     mode = "voting"
+    comm_mode = "voting"
 
 
 _LEARNERS = {

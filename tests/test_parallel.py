@@ -12,8 +12,7 @@ import pytest
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.core.tree_learner import SerialTreeLearner
 from lightgbm_tpu.io.dataset import BinnedDataset
-from lightgbm_tpu.parallel import (DataParallelPsumTreeLearner,
-                                   DataParallelTreeLearner,
+from lightgbm_tpu.parallel import (DataParallelTreeLearner,
                                    FeatureParallelTreeLearner,
                                    PartitionedDataParallelTreeLearner,
                                    VotingParallelTreeLearner,
@@ -48,7 +47,6 @@ def serial_tree(problem):
 
 
 @pytest.mark.parametrize("cls", [DataParallelTreeLearner,
-                                 DataParallelPsumTreeLearner,
                                  FeatureParallelTreeLearner])
 def test_parallel_matches_serial(problem, serial_tree, cls):
     ds, grad, hess = problem
@@ -66,18 +64,25 @@ def test_parallel_matches_serial(problem, serial_tree, cls):
     np.testing.assert_array_equal(got.row_leaf[:N], serial_tree.row_leaf[:N])
 
 
-def test_voting_grows_reasonable_tree(problem, serial_tree):
-    """Voting is an approximation (top-k election); require a same-size tree
-    whose split features come from the serially-useful set."""
+def test_voting_matches_serial(problem, serial_tree):
+    """Full voting-vs-serial PARITY: with 2*top_k=10 of 11 features elected
+    and homogeneously sharded rows, the election never drops the winner, so
+    the voting learner must reproduce the serial tree exactly (the
+    GlobalVoting semantics of voting_parallel_tree_learner.cpp:170-200)."""
     ds, grad, hess = problem
     cfg = Config(num_leaves=15, top_k=5)
     got = _grow(VotingParallelTreeLearner(ds, cfg, mesh=default_mesh()),
                 ds, grad, hess)
-    assert int(got.num_leaves) == int(serial_tree.num_leaves)
-    # with top_k=5 >= F/2 the election cannot drop the winning features here
-    ni = int(got.num_leaves) - 1
+    nl = int(got.num_leaves)
+    assert nl == int(serial_tree.num_leaves)
+    ni = nl - 1
     np.testing.assert_array_equal(got.split_feature[:ni],
                                   serial_tree.split_feature[:ni])
+    np.testing.assert_array_equal(got.threshold_bin[:ni],
+                                  serial_tree.threshold_bin[:ni])
+    np.testing.assert_allclose(got.leaf_value[:nl], serial_tree.leaf_value[:nl],
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(got.row_leaf[:N], serial_tree.row_leaf[:N])
 
 
 def test_feature_pad_indivisible(problem):
