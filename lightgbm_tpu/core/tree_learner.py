@@ -59,7 +59,11 @@ class Comm(NamedTuple):
     - ``psum``: rows sharded; full-histogram allreduce per split.
     - ``feature``: rows replicated, scan sharded over features
       (feature_parallel_tree_learner.cpp:33-71); only the tiny best-split
-      allreduce crosses chips.
+      allreduce crosses chips.  NOTE: unlike the reference (whose machines
+      hold vertical column shards), the partitioned row store must keep
+      every routable column on every chip, so histogram CONSTRUCTION is
+      replicated and only the scan shards — this mode is API parity, not
+      the scaling path (use ``rs``).
     - ``voting``: rows sharded; per-shard top-k feature election + global
       vote, then psum of only the elected features' histograms
       (voting_parallel_tree_learner.cpp:170-366).
@@ -217,8 +221,9 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     parent leaf's window (a bucketed dynamic slice, so cost scales with the
     window), and the smaller child's histogram streams only its own rows
     (serial_tree_learner.cpp:347-356 subtraction trick for the sibling).
-    Identical split semantics to :func:`build_tree`, ~num_leaves× less
-    histogram streaming on deep trees.  With ``axis_name`` set this runs under
+    Split semantics identical to the reference's serial leaf-wise growth;
+    per-split histogram/partition cost scales with the split leaf's window
+    rather than the full data.  With ``axis_name`` set this runs under
     ``jax.shard_map`` with rows sharded: each shard partitions its own rows
     (windows are shard-local), child histograms are ``psum``'d into global
     histograms — the data-parallel comm structure of

@@ -6,9 +6,11 @@ Counterparts of the reference learners created by ``CreateTreeLearner``
 - ``DataParallelTreeLearner`` — rows sharded across chips; per-split global
   histograms by ``psum_scatter`` over the feature axis + allreduce-argmax of
   per-shard best splits (data_parallel_tree_learner.cpp:149-240).
-- ``FeatureParallelTreeLearner`` — data replicated, histogram construction
-  sharded over features; only the best-split argmax crosses chips
-  (feature_parallel_tree_learner.cpp:33-71).
+- ``FeatureParallelTreeLearner`` — data replicated, best-split scan sharded
+  over features; only the best-split argmax crosses chips
+  (feature_parallel_tree_learner.cpp:33-71).  Histogram construction is
+  replicated (the partitioned row store keeps every routable column on every
+  chip) — API parity, not the scaling path.
 - ``VotingParallelTreeLearner`` — rows sharded; top-k feature election keeps
   per-split comm at O(2*top_k*bins) (voting_parallel_tree_learner.cpp:170-366).
 
@@ -62,7 +64,7 @@ class _ParallelTreeLearner(SerialTreeLearner):
         self.mesh = mesh if mesh is not None else default_mesh()
         self.num_shards = int(np.prod(self.mesh.devices.shape))
         self.axis = self.mesh.axis_names[0]
-        self.comm = Comm(axis_name=self.axis, mode=self.mode,
+        self.comm = Comm(axis_name=self.axis, mode=self.comm_mode,
                          num_shards=self.num_shards, top_k=int(config.top_k))
         self._repad(dataset)
         self._build_fn = self._make_build_fn()
