@@ -48,17 +48,27 @@ def _hoisted_jit(fused, example_score):
     Closure-captured arrays are inlined as dense literals in the lowered
     module — at the 10.5M-row Higgs shape the binned matrix alone is a 294 MB
     literal (672 MB of StableHLO total) and the tunneled compile endpoint
-    rejects the program with HTTP 413.  ``jax.closure_convert`` hoists ALL of
-    them (bins, objective label/weight vectors, carried aux) in one sweep.
+    rejects the program with HTTP 413.  ``jax.make_jaxpr`` exposes exactly
+    those captured arrays as ``.consts`` (``jax.closure_convert`` does NOT
+    hoist concrete arrays — only tracer consts), so the program is re-entered
+    through ``eval_jaxpr`` with the consts as real parameters: bins,
+    objective label/weight vectors and the carried aux all in one sweep.
     """
     spec = jax.ShapeDtypeStruct(example_score.shape, example_score.dtype)
-    closed, consts = jax.closure_convert(fused, spec)
-    jitted = jax.jit(closed)
+    closed, out_shape = jax.make_jaxpr(fused, return_shape=True)(spec)
+    out_tree = jax.tree_util.tree_structure(out_shape)
+    consts = closed.consts
+
+    def converted(consts_, score):
+        out = jax.core.eval_jaxpr(closed.jaxpr, consts_, score)
+        return jax.tree_util.tree_unflatten(out_tree, out)
+
+    jitted = jax.jit(converted)
 
     def call(score):
-        return jitted(score, *consts)
+        return jitted(consts, score)
 
-    call.lower = lambda score: jitted.lower(score, *consts)
+    call.lower = lambda score: jitted.lower(consts, score)
     return call
 
 
@@ -733,9 +743,11 @@ class GBDT:
         key = (num_iters, self.shrinkage_rate, self.num_tree_per_iteration)
         fn = self._fused_cache.get(key)
         if fn is None:
-            fn = self._make_fused_train(num_iters)
             try:
-                jax.eval_shape(fn, self.train_score)
+                # _make_fused_train traces eagerly (_hoisted_jit runs
+                # make_jaxpr at construction), so the build itself is the
+                # traceability probe for non-jax objectives
+                fn = self._make_fused_train(num_iters)
             except Exception as exc:  # noqa: BLE001 - objective not traceable
                 Log.debug("Fused training unavailable (%s); falling back", exc)
                 self._fuse_failed = True
