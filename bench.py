@@ -89,10 +89,12 @@ def main() -> None:
     # (chunk read + left in-place write or right scratch write+read+write)
     # and spends ~2*TS*W placement MACs + ~4*f_pad*B histogram MACs per row.
     from lightgbm_tpu.core.partition import TS
-    from lightgbm_tpu.core.histogram import _padded_features, _pad_bins_pow2
     W = 128
-    B = _pad_bins_pow2(max_bin + 1)
-    lanes = _padded_features(f, B) * B
+    B = 32                       # kernel block: next pow2 >= bins, min 32
+    while B < max_bin + 1:
+        B *= 2
+    fp = max(1, 128 // B)        # features packed per 128-lane MXU tile
+    lanes = (-(-f // fp) * fp) * B
     visits = 0.0
     hist_rows = 0.0
     trees = booster.models[-iters:]

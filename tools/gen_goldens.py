@@ -1,0 +1,114 @@
+"""Regenerate tests/data/golden_metrics.json entries from the reference CLI.
+
+Runs the reference LightGBM CLI (built from /root/reference, see PERF notes:
+/tmp/refbuild/lightgbm) on the bundled example datasets for every parity
+config and captures its per-iteration metric lines.  The four example
+configs' goldens were captured in round 3; round 4 adds the remaining
+training modes (VERDICT item 6): dart, goss, rf, monotone constraints,
+forced splits, and a sparse LibSVM load.
+
+Usage:  python tools/gen_goldens.py [path-to-reference-cli]
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_EXAMPLES = "/root/reference/examples"
+GOLDEN = os.path.join(REPO, "tests", "data", "golden_metrics.json")
+ITERS = (10, 25, 50)
+
+# name -> (example dir for data files, overrides)
+CONFIGS = {
+    "dart": ("binary_classification", {
+        "boosting_type": "dart", "drop_rate": 0.1, "skip_drop": 0.5}),
+    "goss": ("binary_classification", {
+        "boosting_type": "goss", "bagging_freq": 0, "bagging_fraction": 1.0}),
+    "rf": ("binary_classification", {
+        "boosting_type": "rf", "bagging_freq": 1, "bagging_fraction": 0.9,
+        "feature_fraction": 0.9}),
+    "monotone": ("regression", {
+        "monotone_constraints": ",".join(
+            ["1", "-1", "1", "0", "0", "-1"] + ["0"] * 22)}),
+    "forced_splits": ("binary_classification", {
+        "forcedsplits_filename": "__FORCED__",
+        "feature_fraction": 1.0, "bagging_freq": 0, "bagging_fraction": 1.0}),
+    # binary objective over the lambdarank LibSVM file: a deterministic
+    # sparse-ingestion parity pin (relevance>0 counts as positive)
+    "sparse_binary": ("lambdarank", {
+        "objective": "binary", "metric": "binary_logloss,auc",
+        "num_leaves": 31, "min_data_in_leaf": 20,
+        "feature_fraction": 1.0, "bagging_freq": 0, "bagging_fraction": 1.0}),
+}
+
+FORCED_JSON = {
+    "feature": 1, "threshold": 0.5,
+    "left": {"feature": 5, "threshold": 1.0},
+}
+
+DATA_FILES = {
+    "binary_classification": ("binary.train", "binary.test"),
+    "regression": ("regression.train", "regression.test"),
+    "lambdarank": ("rank.train", "rank.test"),
+}
+
+
+def run_reference(cli, name, example, overrides, workdir):
+    base = os.path.join(REF_EXAMPLES, example, "train.conf")
+    params = {}
+    with open(base) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if "=" in line:
+                k, v = line.split("=", 1)
+                params[k.strip()] = v.strip()
+    train, test = DATA_FILES[example]
+    params["data"] = os.path.join(REF_EXAMPLES, example, train)
+    params["valid_data"] = os.path.join(REF_EXAMPLES, example, test)
+    params["num_trees"] = str(max(ITERS))
+    params["metric_freq"] = "1"
+    params["is_training_metric"] = "true"
+    params.pop("output_model", None)
+    for k, v in overrides.items():
+        params[k] = str(v)
+    if params.get("forcedsplits_filename") == "__FORCED__":
+        fpath = os.path.join(workdir, "forced.json")
+        with open(fpath, "w") as fh:
+            json.dump(FORCED_JSON, fh)
+        params["forcedsplits_filename"] = fpath
+    conf = os.path.join(workdir, name + ".conf")
+    with open(conf, "w") as fh:
+        for k, v in params.items():
+            fh.write("%s = %s\n" % (k, v))
+    out = subprocess.run([cli, "config=" + conf], capture_output=True,
+                         text=True, cwd=workdir, check=True)
+    log = out.stdout + out.stderr
+    # [LightGBM] [Info] Iteration:10, training auc : 0.9...
+    metrics = {}
+    for m in re.finditer(
+            r"Iteration:\s*(\d+),\s*(\S+)\s+(\S+)\s*:\s*([-\d.eE+]+)", log):
+        it, ds, metric, val = m.groups()
+        metrics.setdefault(it, {})["%s %s" % (ds, metric)] = float(val)
+    return {str(i): metrics[str(i)] for i in ITERS}
+
+
+def main():
+    cli = sys.argv[1] if len(sys.argv) > 1 else "/tmp/refbuild/lightgbm"
+    with open(GOLDEN) as fh:
+        golden = json.load(fh)
+    with tempfile.TemporaryDirectory() as workdir:
+        for name, (example, overrides) in CONFIGS.items():
+            print("running reference:", name)
+            golden[name] = run_reference(cli, name, example, overrides,
+                                         workdir)
+    with open(GOLDEN, "w") as fh:
+        json.dump(golden, fh, indent=1)
+        fh.write("\n")
+    print("wrote", GOLDEN)
+
+
+if __name__ == "__main__":
+    main()
