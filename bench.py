@@ -89,12 +89,12 @@ def main() -> None:
     # (chunk read + left in-place write or right scratch write+read+write)
     # and spends ~2*TS*W placement MACs + ~4*f_pad*B histogram MACs per row.
     from lightgbm_tpu.core.partition import TS
+    # private-but-shared padding helpers: bench MUST mirror the kernel's own
+    # padding rule or the MFU accounting silently diverges from real cost
+    from lightgbm_tpu.core.histogram import _pad_bins_pow2, _padded_features
     W = 128
-    B = 32                       # kernel block: next pow2 >= bins, min 32
-    while B < max_bin + 1:
-        B *= 2
-    fp = max(1, 128 // B)        # features packed per 128-lane MXU tile
-    lanes = (-(-f // fp) * fp) * B
+    B = _pad_bins_pow2(max_bin + 1)
+    lanes = _padded_features(f, B) * B
     visits = 0.0
     hist_rows = 0.0
     trees = booster.models[-iters:]

@@ -628,6 +628,7 @@ class GBDT:
                       unpack_lanes=learner.unpack_lanes,
                       forced=learner.forced,
                       packed_cols=learner.packed_cols,
+                      hist_pool_slots=learner.hist_pool_slots,
                       carried=True)
 
         def f32col(rows, off):
@@ -699,7 +700,8 @@ class GBDT:
                       feat_num_bins=learner.feat_bins,
                       unpack_lanes=learner.unpack_lanes,
                       forced=learner.forced,
-                      packed_cols=learner.packed_cols)
+                      packed_cols=learner.packed_cols,
+                      hist_pool_slots=learner.hist_pool_slots)
 
         def one_iter_of(bins):
             def one_iter(score, _):

@@ -161,6 +161,9 @@ class _PState(NamedTuple):
     fbc: object                 # FeatureBest arrays [L, F] — per-(leaf,
                                 # feature) cached candidates for the CEGB
                                 # coupled refund (() when CEGB is off)
+    slot_of: jax.Array          # [L] i32 histogram-pool slot per leaf, -1 =
+                                # evicted (() when the pool is unbounded)
+    stamps: jax.Array           # [K] i32 LRU stamps per pool slot (())
 
 
 def _ffill_nonzero(x: jax.Array) -> jax.Array:
@@ -195,7 +198,8 @@ def _ffill_pair(flag: jax.Array, val: jax.Array):
     static_argnames=("num_leaves", "max_depth", "params", "num_bins",
                      "use_pallas", "has_categorical", "has_monotone",
                      "feat_num_bins", "packed_cols", "axis_name",
-                     "comm_mode", "num_shards", "carried", "top_k"))
+                     "comm_mode", "num_shards", "carried", "top_k",
+                     "hist_pool_slots"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -212,6 +216,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_shards: int = 1,
                            carried: bool = False,
                            top_k: int = 20,
+                           hist_pool_slots: int = 0,
                            rows_carry=None, extra=None, score_rate=None):
     """Leaf-wise growth with per-leaf physical row partitions.
 
@@ -640,7 +645,24 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         cat_bitset=jnp.zeros((L, B // 32), dtype=jnp.uint32),
         num_leaves=jnp.int32(1), row_leaf=jnp.zeros((n,), dtype=jnp.int32))
 
-    hist = jnp.zeros((L,) + hist0.shape, dtype=f32).at[0].set(hist0)
+    # Histogram state: unbounded keeps one slot per leaf ([L, F, 2, B], the
+    # round-3 behavior); histogram_pool_size > 0 bounds it to K LRU slots
+    # (the reference's HistogramPool, feature_histogram.hpp:687) — an evicted
+    # parent is REBUILT by streaming its window, which post-partition still
+    # holds exactly the parent's rows.
+    pooled = hist_pool_slots > 0
+    if pooled:
+        assert forced is None and cegb is None, \
+            "histogram_pool_size needs the full per-leaf cache for forced " \
+            "splits / CEGB candidate bookkeeping"
+        K_slots = max(2, min(hist_pool_slots, L))
+        hist = jnp.zeros((K_slots,) + hist0.shape, dtype=f32).at[0].set(hist0)
+        slot_of0 = jnp.full((L,), -1, jnp.int32).at[0].set(0)
+        stamps0 = jnp.full((K_slots,), -1, jnp.int32).at[0].set(0)
+    else:
+        hist = jnp.zeros((L,) + hist0.shape, dtype=f32).at[0].set(hist0)
+        slot_of0 = ()
+        stamps0 = ()
     bests = BestSplit(*[jnp.broadcast_to(x, (L,) + x.shape).astype(x.dtype)
                         for x in best0])
     state = _PState(tree=tree, hist=hist, bests=bests, cont=jnp.bool_(True),
@@ -653,7 +675,9 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     lsum_h=zl().at[0].set(sum_h),
                     feat_used=used0,
                     force_on=jnp.bool_(True),
-                    fbc=fbc0)
+                    fbc=fbc0,
+                    slot_of=slot_of0,
+                    stamps=stamps0)
 
     def body(k, st: _PState) -> _PState:
         node = k - 1
@@ -742,11 +766,40 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             """Masked state write: keep ``old`` on dead iterations."""
             return jnp.where(ok, new, old)
 
-        hist_larger = st.hist[leaf] - hist_small
-        hist_left = jnp.where(left_smaller, hist_small, hist_larger)
-        hist_right = jnp.where(left_smaller, hist_larger, hist_small)
-        hist_new = st.hist.at[leaf].set(sel(hist_left, st.hist[leaf])) \
-                          .at[k].set(sel(hist_right, st.hist[k]))
+        if pooled:
+            # parent histogram from its LRU slot, or rebuilt by streaming the
+            # window (post-partition it still holds exactly the parent rows —
+            # HistogramPool::Get miss, feature_histogram.hpp:687)
+            ps = st.slot_of[leaf]
+
+            def _hit(_):
+                return st.hist[jnp.maximum(ps, 0)]
+
+            def _miss(_):
+                return reduce_hist(hist_rows(rows_new, wb, wc))
+
+            parent_hist = jax.lax.cond(ps >= 0, _hit, _miss, 0)
+            hist_larger = parent_hist - hist_small
+            hist_left = jnp.where(left_smaller, hist_small, hist_larger)
+            hist_right = jnp.where(left_smaller, hist_larger, hist_small)
+            # left child inherits the parent's slot (or the LRU slot on a
+            # miss); right child evicts the next-least-recently-used slot
+            sL = jnp.where(ps >= 0, ps, jnp.argmin(st.stamps).astype(jnp.int32))
+            sR = jnp.argmin(st.stamps.at[sL].set(2 ** 30)).astype(jnp.int32)
+            hist_new = st.hist.at[sL].set(sel(hist_left, st.hist[sL])) \
+                              .at[sR].set(sel(hist_right, st.hist[sR]))
+            stamps_new = st.stamps.at[sL].set(k).at[sR].set(k)
+            slot_upd = jnp.where((st.slot_of == sL) | (st.slot_of == sR),
+                                 -1, st.slot_of)
+            slot_upd = slot_upd.at[leaf].set(sL).at[k].set(sR)
+        else:
+            hist_larger = st.hist[leaf] - hist_small
+            hist_left = jnp.where(left_smaller, hist_small, hist_larger)
+            hist_right = jnp.where(left_smaller, hist_larger, hist_small)
+            hist_new = st.hist.at[leaf].set(sel(hist_left, st.hist[leaf])) \
+                              .at[k].set(sel(hist_right, st.hist[k]))
+            stamps_new = st.stamps
+            slot_upd = st.slot_of
 
         begin = st.begin.at[k].set(wb + nl)
         wcount = st.wcount.at[leaf].set(nl).at[k].set(wc - nl)
@@ -874,17 +927,20 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         lsum_h = st.lsum_h.at[leaf].set(b.left_sum_hess).at[k].set(
             b.right_sum_hess)
         small_new = (tree_new, bests, cmin_new, cmax_new, begin, wcount,
-                     lsum_g, lsum_h, feat_used, fbc)
+                     lsum_g, lsum_h, feat_used, fbc, slot_upd, stamps_new)
         small_old = (t, st.bests, st.cmin, st.cmax, st.begin, st.wcount,
-                     st.lsum_g, st.lsum_h, st.feat_used, st.fbc)
+                     st.lsum_g, st.lsum_h, st.feat_used, st.fbc,
+                     st.slot_of, st.stamps)
         (tree_m, bests_m, cmin_m, cmax_m, begin_m, wcount_m, lsg_m, lsh_m,
-         fu_m, fbc_m) = jax.tree_util.tree_map(sel, small_new, small_old)
+         fu_m, fbc_m, slot_m, stamps_m) = jax.tree_util.tree_map(
+            sel, small_new, small_old)
         return _PState(tree=tree_m, hist=hist_new, bests=bests_m,
                        cont=ok, cmin=cmin_m, cmax=cmax_m,
                        begin=begin_m, wcount=wcount_m,
                        rows=rows_new,
                        lsum_g=lsg_m, lsum_h=lsh_m, feat_used=fu_m,
-                       force_on=st.force_on, fbc=fbc_m)
+                       force_on=st.force_on, fbc=fbc_m,
+                       slot_of=slot_m, stamps=stamps_m)
 
     if L > 1:
         state = jax.lax.fori_loop(1, L, body, state)
@@ -1032,6 +1088,24 @@ class SerialTreeLearner:
         self._upload_bins(matrix)
         self.forced = self._load_forced_splits(config, dataset)
         self.cegb = self._init_cegb(config, dataset)
+        # histogram_pool_size MB -> LRU slot count (reference HistogramPool,
+        # feature_histogram.hpp:687; <=0 keeps one slot per leaf)
+        pool_mb = float(getattr(config, "histogram_pool_size", -1.0))
+        self.hist_pool_slots = 0
+        if pool_mb > 0 and self.forced is None and self.cegb is None:
+            # stored block is [f_cols, 2, num_bins] f32; MiB like the
+            # reference's pool sizing
+            if hasattr(self, "bins"):
+                width = self.bins.shape[1]
+            elif hasattr(self, "_host_bins"):
+                width = self._host_bins.shape[1]
+            else:
+                width = 0
+            f_cols = self.packed_cols or width
+            if f_cols:
+                slot_bytes = f_cols * 2 * self.num_bins * 4
+                self.hist_pool_slots = max(
+                    2, int(pool_mb * 1024 * 1024 // slot_bytes))
         self.cegb_used = (jnp.zeros((dataset.num_features,), bool)
                           if self.cegb is not None else None)
         # per-(row, feature) lazy-cost paid bits, persisted across trees
@@ -1151,7 +1225,8 @@ class SerialTreeLearner:
             unpack_lanes=self.unpack_lanes,
             forced=self.forced, cegb=cegb,
             paid_bits=(self.cegb_paid if lazy_active else None),
-            packed_cols=self.packed_cols)
+            packed_cols=self.packed_cols,
+            hist_pool_slots=self.hist_pool_slots)
         if lazy_active:
             # per-(row, feature) paid bits live for the whole training
             # (feature_used_in_data_)

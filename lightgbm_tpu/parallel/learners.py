@@ -119,7 +119,8 @@ class _ParallelTreeLearner(SerialTreeLearner):
             feat_num_bins=self.feat_bins, unpack_lanes=self.unpack_lanes,
             packed_cols=self.packed_cols, axis_name=self.axis,
             comm_mode=self.comm_mode, num_shards=self.num_shards,
-            top_k=int(self.comm.top_k))
+            top_k=int(self.comm.top_k),
+            hist_pool_slots=self.hist_pool_slots)
         row = P() if self.mode == "feature" else P(self.axis)
         bins_spec = P() if self.mode == "feature" else P(self.axis, None)
         out_specs = TreeArrays(
@@ -193,6 +194,7 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
                 feat_num_bins=self.feat_bins,
                 unpack_lanes=self.unpack_lanes,
                 packed_cols=self.packed_cols, axis_name=self.axis,
+                hist_pool_slots=self.hist_pool_slots,
                 forced=forced,
                 cegb=(cegb_args if cegb_args != () else None),
                 paid_bits=(paid if lazy else None))

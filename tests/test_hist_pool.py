@@ -1,0 +1,88 @@
+"""Bounded histogram memory: the LRU HistogramPool counterpart.
+
+The reference bounds per-tree histogram memory with an LRU pool sized by
+``histogram_pool_size`` MB (src/treelearner/feature_histogram.hpp:687),
+recomputing evicted parents.  Here the pool replaces the resident
+[num_leaves, F, 2, B] tensor with [K, F, 2, B] slots; an evicted parent is
+rebuilt by streaming its (post-partition) window.  Peak histogram HBM is
+then independent of num_leaves.
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.tree_learner import SerialTreeLearner
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _problem(n=3000, f=10, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 3) + X[:, 2] * X[:, 3] \
+        + rng.normal(scale=0.1, size=n)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    grad = jnp.asarray(-(y - y.mean()).astype(np.float32))
+    hess = jnp.ones((n,), jnp.float32)
+    return ds, grad, hess, n
+
+
+def test_pooled_build_matches_unbounded():
+    """K=4 slots on a 31-leaf tree forces constant eviction + parent
+    rebuilds; the grown tree must be IDENTICAL to the unbounded build."""
+    ds, grad, hess, n = _problem()
+    base = SerialTreeLearner(ds, Config(num_leaves=31, min_data_in_leaf=5))
+    want = jax.tree_util.tree_map(np.asarray, base.train(grad, hess, n))
+
+    ds2, grad, hess, n = _problem()
+    pooled = SerialTreeLearner(ds2, Config(num_leaves=31, min_data_in_leaf=5,
+                                           histogram_pool_size=1))
+    pooled.hist_pool_slots = 4          # force heavy eviction
+    got = jax.tree_util.tree_map(np.asarray, pooled.train(grad, hess, n))
+
+    nl = int(want.num_leaves)
+    assert int(got.num_leaves) == nl
+    np.testing.assert_array_equal(got.split_feature[:nl - 1],
+                                  want.split_feature[:nl - 1])
+    np.testing.assert_array_equal(got.threshold_bin[:nl - 1],
+                                  want.threshold_bin[:nl - 1])
+    np.testing.assert_allclose(got.leaf_value[:nl], want.leaf_value[:nl],
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(got.row_leaf, want.row_leaf)
+
+
+def test_pool_bounds_lowered_histogram_state():
+    """The lowered program's histogram state is [K, ...], independent of
+    num_leaves — the wide-feature memory bound the pool exists for."""
+    ds, grad, hess, n = _problem(f=12)
+    lrn = SerialTreeLearner(ds, Config(num_leaves=255, min_data_in_leaf=2,
+                                       histogram_pool_size=1))
+    lrn.hist_pool_slots = 8
+    from lightgbm_tpu.core.tree_learner import build_tree_partitioned
+    fm = jnp.ones((ds.num_features,), bool)
+    lowered = build_tree_partitioned.lower(
+        lrn.bins, lrn.pad_rows(grad), lrn.pad_rows(hess), jnp.int32(n), fm,
+        lrn.feat, num_leaves=255, max_depth=-1, params=lrn.params,
+        num_bins=lrn.num_bins, use_pallas=False,
+        feat_num_bins=lrn.feat_bins, unpack_lanes=lrn.unpack_lanes,
+        packed_cols=lrn.packed_cols, hist_pool_slots=8)
+    txt = lowered.as_text()
+    f_cols = lrn.packed_cols or lrn.bins.shape[1]
+    b = lrn.num_bins
+    assert re.search(rf"tensor<8x{f_cols}x2x{b}xf32>", txt), \
+        "pooled histogram state [K, F, 2, B] not found"
+    assert not re.search(rf"tensor<255x{f_cols}x2x{b}xf32>", txt), \
+        "per-leaf histogram state must not be resident when pooled"
+
+
+def test_config_sizing():
+    ds, *_ = _problem(f=8)
+    lrn = SerialTreeLearner(ds, Config(num_leaves=31,
+                                       histogram_pool_size=0.5))
+    # 0.5 MiB / (f_cols * 2 * B * 4 bytes) slots, floor 2 (MiB like the
+    # reference's HistogramPool sizing)
+    f_cols = lrn.packed_cols or lrn.bins.shape[1]
+    expect = max(2, int(0.5 * 1024 * 1024 // (f_cols * 2 * lrn.num_bins * 4)))
+    assert lrn.hist_pool_slots == expect

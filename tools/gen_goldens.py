@@ -19,7 +19,7 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REF_EXAMPLES = "/root/reference/examples"
 GOLDEN = os.path.join(REPO, "tests", "data", "golden_metrics.json")
-ITERS = (10, 25, 50)
+ITERS = (10, 25, 50, 100)
 
 # name -> (example dir for data files, overrides)
 CONFIGS = {
