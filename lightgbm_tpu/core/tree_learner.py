@@ -1092,6 +1092,11 @@ class SerialTreeLearner:
         # feature_histogram.hpp:687; <=0 keeps one slot per leaf)
         pool_mb = float(getattr(config, "histogram_pool_size", -1.0))
         self.hist_pool_slots = 0
+        if pool_mb > 0 and not (self.forced is None and self.cegb is None):
+            from ..utils.log import Log
+            Log.warning("histogram_pool_size is ignored with forced splits "
+                        "or CEGB (their candidate caches need every leaf's "
+                        "histogram resident); histogram memory is unbounded")
         if pool_mb > 0 and self.forced is None and self.cegb is None:
             # stored block is [f_cols, 2, num_bins] f32; MiB like the
             # reference's pool sizing

@@ -335,6 +335,36 @@ class DatasetLoader:
 
     _TWO_ROUND_CHUNK = 65536
 
+    @staticmethod
+    def _prefetch(iterator, depth: int = 2):
+        """Background-thread chunk prefetch — the ``PipelineReader`` role
+        (include/LightGBM/utils/pipeline_reader.h:24 double-buffered read):
+        the next chunk is read+parsed while the consumer bins the current
+        one (pandas' C parser and numpy binning both release the GIL)."""
+        import queue
+        import threading
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        sentinel = object()
+        err = []
+
+        def worker():
+            try:
+                for item in iterator:
+                    q.put(item)
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                err.append(exc)
+            finally:
+                q.put(sentinel)
+
+        threading.Thread(target=worker, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
     def _load_two_round(self, filename: str, rank: int = 0,
                         num_machines: int = 1,
                         reference: Optional[BinnedDataset] = None
@@ -425,9 +455,10 @@ class DatasetLoader:
 
         pos = 0       # global row cursor in the file
         wpos = 0      # write cursor into the kept stripe
-        for chunk in stream_file(filename, self._TWO_ROUND_CHUNK, header,
-                                 num_cols=(full_cols - 1 if fmt == "libsvm"
-                                           else None)):
+        for chunk in self._prefetch(
+                stream_file(filename, self._TWO_ROUND_CHUNK, header,
+                            num_cols=(full_cols - 1 if fmt == "libsvm"
+                                      else None))):
             m = chunk.shape[0]
             lo, hi = max(begin - pos, 0), min(end - pos, m)
             pos += m
