@@ -1,0 +1,53 @@
+# Training callbacks (role of the reference R-package/R/callback.R):
+# closures invoked once per iteration with the shared training env
+# (env$iter, env$evals named per valid set, env$booster, env$stop).
+
+#' Record per-iteration evaluation results into env$record
+#' @export
+cb_record_evaluation <- function() {
+  function(env) {
+    if (is.null(env$record)) env$record <- list()
+    for (nm in names(env$evals)) {
+      env$record[[nm]] <- c(env$record[[nm]], list(env$evals[[nm]]))
+    }
+  }
+}
+
+#' Print evaluation results every `period` iterations
+#' @export
+cb_print_evaluation <- function(period = 1L) {
+  function(env) {
+    if (env$iter %% period != 0L) return(invisible())
+    for (nm in names(env$evals)) {
+      vals <- paste(sprintf("%.6f", env$evals[[nm]]), collapse = ", ")
+      message(sprintf("[%d] %s: %s", env$iter, nm, vals))
+    }
+  }
+}
+
+#' Early stopping: stop when the FIRST metric of the FIRST valid set has not
+#' improved for `rounds` iterations (lower is better unless the booster's
+#' params name a higher-better metric such as auc/ndcg/map)
+#' @export
+cb_early_stop <- function(rounds) {
+  best <- NULL
+  best_iter <- 0L
+  function(env) {
+    if (length(env$evals) == 0L) return(invisible())
+    v <- env$evals[[1L]][1L]
+    metrics <- tolower(unlist(strsplit(
+      paste(env$booster$params$metric, collapse = ","), ",")))
+    higher <- any(grepl("^auc", metrics[1]) | grepl("^ndcg", metrics[1])
+                  | metrics[1] == "map" | grepl("^map@", metrics[1]))
+    improved <- is.null(best) || (if (higher) v > best else v < best)
+    if (improved) {
+      best <<- v
+      best_iter <<- env$iter
+      env$booster$best_iter <- env$iter
+    } else if (env$iter - best_iter >= rounds) {
+      message(sprintf("Early stopping at iteration %d (best %d)",
+                      env$iter, best_iter))
+      env$stop <- TRUE
+    }
+  }
+}

@@ -1,0 +1,122 @@
+# In-process Booster over the C ABI (role of R-package/R/lgb.Booster.R in
+# the reference: train updates, eval, predict with rawscore/leaf/contrib,
+# model text round-trip).  Falls back to nothing here: callers that cannot
+# load the compiled glue use the CLI layer in lightgbm.R.
+
+.PREDICT_NORMAL <- 0L
+.PREDICT_RAW <- 1L
+.PREDICT_LEAF <- 2L
+.PREDICT_CONTRIB <- 3L
+
+.lgbmtpu_new_booster <- function(handle, params = list()) {
+  bst <- new.env(parent = emptyenv())
+  bst$handle <- handle
+  bst$params <- params
+  bst$best_iter <- -1L
+  bst$record_evals <- list()
+  class(bst) <- "lgb.Booster"
+  bst
+}
+
+#' Create a Booster on a constructed training Dataset
+#' @export
+lgb.Booster <- function(train_set, params = list()) {
+  h <- .Call("R_lgbmtpu_booster_create", .lgbmtpu_construct(train_set),
+             .lgbmtpu_params_str(params), PACKAGE = "lightgbm_tpu")
+  .lgbmtpu_new_booster(h, params)
+}
+
+#' One boosting update (gbdt.cpp TrainOneIter)
+#' @export
+lgb.update <- function(booster) {
+  invisible(.Call("R_lgbmtpu_booster_update", booster$handle,
+                  PACKAGE = "lightgbm_tpu"))
+}
+
+#' Evaluation results for data_idx (0 = train, 1.. = valids)
+#' @export
+lgb.eval <- function(booster, data_idx = 0L) {
+  .Call("R_lgbmtpu_booster_eval", booster$handle, as.integer(data_idx),
+        PACKAGE = "lightgbm_tpu")
+}
+
+#' @export
+lgb.current.iter <- function(booster) {
+  .Call("R_lgbmtpu_booster_cur_iter", booster$handle,
+        PACKAGE = "lightgbm_tpu")
+}
+
+#' Predict: response, raw score, leaf indices or SHAP contributions
+#' @param rawscore return the raw (margin) score
+#' @param predleaf return per-tree leaf indices
+#' @param predcontrib return per-feature contributions (+ bias column)
+#' @export
+predict.lgb.Booster <- function(object, data, rawscore = FALSE,
+                                predleaf = FALSE, predcontrib = FALSE,
+                                num_iteration = -1L, ...) {
+  if (!.lgbmtpu_glue_loaded() || is.null(object$handle)) {
+    return(.lgbmtpu_cli_predict(object, data, rawscore = rawscore,
+                                predleaf = predleaf,
+                                predcontrib = predcontrib,
+                                num_iteration = num_iteration))
+  }
+  ptype <- .PREDICT_NORMAL
+  if (rawscore) ptype <- .PREDICT_RAW
+  if (predleaf) ptype <- .PREDICT_LEAF
+  if (predcontrib) ptype <- .PREDICT_CONTRIB
+  m <- as.matrix(data)
+  storage.mode(m) <- "double"
+  out <- .Call("R_lgbmtpu_booster_predict_mat", object$handle, m, nrow(m),
+               ncol(m), as.integer(ptype), as.integer(num_iteration), "",
+               PACKAGE = "lightgbm_tpu")
+  per_row <- length(out) %/% nrow(m)
+  if (per_row > 1L) {
+    # C ABI returns row-major [nrow, per_row]
+    out <- matrix(out, nrow = nrow(m), ncol = per_row, byrow = TRUE)
+  }
+  out
+}
+
+#' Save the model in the reference-compatible text format
+#' @export
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  if (!.lgbmtpu_glue_loaded() || is.null(booster$handle)) {
+    return(.lgbmtpu_cli_save(booster, filename))
+  }
+  .Call("R_lgbmtpu_booster_save", booster$handle, filename,
+        as.integer(num_iteration), PACKAGE = "lightgbm_tpu")
+  invisible(booster)
+}
+
+#' Model text (lgb.dump role; reference-format string)
+#' @export
+lgb.model.to.string <- function(booster, num_iteration = -1L) {
+  if (is.null(booster$handle)) return(booster$model_str)
+  .Call("R_lgbmtpu_booster_to_string", booster$handle,
+        as.integer(num_iteration), PACKAGE = "lightgbm_tpu")
+}
+
+#' Load a Booster from a model file or string
+#' @export
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  if (is.null(model_str)) {
+    model_str <- paste(readLines(filename), collapse = "\n")
+  }
+  if (!.lgbmtpu_glue_loaded()) {
+    return(.lgbmtpu_cli_load(model_str))
+  }
+  res <- .Call("R_lgbmtpu_booster_from_string", model_str,
+               PACKAGE = "lightgbm_tpu")
+  bst <- .lgbmtpu_new_booster(res[[1L]])
+  bst$num_iter <- res[[2L]]
+  bst
+}
+
+#' Per-feature importance via the C ABI (0 = split counts, 1 = total gain)
+#' @export
+lgb.feature.importance.raw <- function(booster, num_iteration = -1L,
+                                       importance_type = 1L) {
+  .Call("R_lgbmtpu_booster_importance", booster$handle,
+        as.integer(num_iteration), as.integer(importance_type),
+        PACKAGE = "lightgbm_tpu")
+}

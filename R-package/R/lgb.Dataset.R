@@ -1,0 +1,76 @@
+# Dataset construction over the in-process C ABI.
+# Role of the reference R-package/R/lgb.Dataset.R: a lazily-constructed
+# handle plus label/weight/group fields; validation sets bind to their
+# training dataset's bin mappers via `reference`.
+
+.lgbmtpu_params_str <- function(params) {
+  if (length(params) == 0L) return("")
+  paste(sprintf("%s=%s", names(params),
+                vapply(params, function(v) paste(v, collapse = ","),
+                       character(1L))),
+        collapse = " ")
+}
+
+.lgbmtpu_glue_loaded <- function() {
+  is.loaded("R_lgbmtpu_booster_create", PACKAGE = "lightgbm_tpu")
+}
+
+#' Construct a lightgbm.tpu Dataset
+#' @param data numeric matrix or path to a data file
+#' @param label numeric label vector (matrix input)
+#' @param reference training Dataset whose bin mappers validation data reuse
+#' @param params named list of dataset parameters (max_bin, ...)
+#' @export
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        reference = NULL, params = list(), ...) {
+  ds <- new.env(parent = emptyenv())
+  ds$data <- data
+  ds$label <- label
+  ds$weight <- weight
+  ds$group <- group
+  ds$params <- c(params, list(...))
+  ds$reference <- reference
+  ds$handle <- NULL
+  class(ds) <- "lgb.Dataset"
+  ds
+}
+
+#' @export
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL, ...) {
+  lgb.Dataset(data, label = label, reference = dataset, ...)
+}
+
+.lgbmtpu_construct <- function(ds) {
+  if (!is.null(ds$handle)) return(ds$handle)
+  stopifnot(.lgbmtpu_glue_loaded())
+  pstr <- .lgbmtpu_params_str(ds$params)
+  ref <- if (is.null(ds$reference)) NULL else .lgbmtpu_construct(ds$reference)
+  if (is.character(ds$data)) {
+    ds$handle <- .Call("R_lgbmtpu_dataset_from_file", ds$data, pstr, ref,
+                       PACKAGE = "lightgbm_tpu")
+  } else {
+    m <- as.matrix(ds$data)
+    storage.mode(m) <- "double"
+    ds$handle <- .Call("R_lgbmtpu_dataset_from_mat", m, nrow(m), ncol(m),
+                       pstr, ref, PACKAGE = "lightgbm_tpu")
+    if (!is.null(ds$label)) {
+      .Call("R_lgbmtpu_dataset_set_field", ds$handle, "label",
+            as.double(ds$label), PACKAGE = "lightgbm_tpu")
+    }
+  }
+  if (!is.null(ds$weight)) {
+    .Call("R_lgbmtpu_dataset_set_field", ds$handle, "weight",
+          as.double(ds$weight), PACKAGE = "lightgbm_tpu")
+  }
+  if (!is.null(ds$group)) {
+    .Call("R_lgbmtpu_dataset_set_field", ds$handle, "group",
+          as.double(ds$group), PACKAGE = "lightgbm_tpu")
+  }
+  ds$handle
+}
+
+#' @export
+dim.lgb.Dataset <- function(x) {
+  if (is.character(x$data)) stop("dim() needs an in-memory Dataset")
+  dim(as.matrix(x$data))
+}

@@ -1,0 +1,55 @@
+# Feature importance — role of the reference R-package/R/lgb.importance.R:
+# split counts AND total gain, with percentage normalization.  In-process it
+# uses the C ABI; otherwise it is computed from the model text via
+# lgb.model.dt.tree (same numbers the reference derives from its dump).
+
+#' @param importance_type "gain" or "split"
+#' @export
+lgb.importance <- function(booster = NULL, model_str = NULL,
+                           percentage = TRUE) {
+  if (!is.null(booster) && .lgbmtpu_glue_loaded()
+      && !is.null(booster$handle)) {
+    gain <- lgb.feature.importance.raw(booster, importance_type = 1L)
+    split <- lgb.feature.importance.raw(booster, importance_type = 0L)
+    df <- data.frame(Feature = paste0("Column_", seq_along(gain) - 1L),
+                     Gain = gain, Cover = NA_real_, Frequency = split,
+                     stringsAsFactors = FALSE)
+  } else {
+    dt <- lgb.model.dt.tree(booster, model_str)
+    internal <- dt[dt$node_type == "internal", , drop = FALSE]
+    if (nrow(internal) == 0L) {
+      return(data.frame(Feature = character(0), Gain = numeric(0),
+                        Cover = numeric(0), Frequency = numeric(0)))
+    }
+    gain <- tapply(internal$split_gain, internal$split_feature, sum)
+    freq <- tapply(rep(1, nrow(internal)), internal$split_feature, sum)
+    feats <- as.integer(names(gain))
+    df <- data.frame(Feature = paste0("Column_", feats),
+                     Gain = as.numeric(gain), Cover = NA_real_,
+                     Frequency = as.numeric(freq), stringsAsFactors = FALSE)
+  }
+  df <- df[df$Gain > 0 | df$Frequency > 0, , drop = FALSE]
+  df <- df[order(-df$Gain), , drop = FALSE]
+  if (percentage) {
+    if (sum(df$Gain) > 0) df$Gain <- df$Gain / sum(df$Gain)
+    if (sum(df$Frequency) > 0) df$Frequency <- df$Frequency / sum(df$Frequency)
+  }
+  rownames(df) <- NULL
+  df
+}
+
+#' Per-prediction feature contributions (lgb.interprete role): SHAP-style
+#' contribution of every feature to each selected row's prediction.
+#' @export
+lgb.interprete <- function(booster, data, idxset = seq_len(nrow(data))) {
+  contrib <- predict(booster, data[idxset, , drop = FALSE],
+                     predcontrib = TRUE)
+  lapply(seq_along(idxset), function(i) {
+    row <- contrib[i, ]
+    nfeat <- length(row) - 1L
+    df <- data.frame(Feature = c(paste0("Column_", seq_len(nfeat) - 1L),
+                                 "BIAS"),
+                     Contribution = row, stringsAsFactors = FALSE)
+    df[order(-abs(df$Contribution)), , drop = FALSE]
+  })
+}

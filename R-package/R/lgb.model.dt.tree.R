@@ -1,0 +1,62 @@
+# Parse the reference-format model text into a per-node table — role of the
+# reference R-package/R/lgb.model.dt.tree.R (theirs walks the JSON dump;
+# this walks the text model's per-tree arrays directly, so it needs no JSON
+# parser and works on any saved model file).
+
+.lgbmtpu_tree_blocks <- function(model_str) {
+  lines <- strsplit(model_str, "\n", fixed = TRUE)[[1L]]
+  starts <- grep("^Tree=", lines)
+  ends <- c(starts[-1L] - 1L, length(lines))
+  Map(function(s, e) lines[s:e], starts, ends)
+}
+
+.lgbmtpu_field <- function(block, name) {
+  row <- grep(paste0("^", name, "="), block, value = TRUE)
+  if (length(row) == 0L) return(numeric(0))
+  txt <- sub(paste0("^", name, "="), "", row[1L])
+  if (!nzchar(txt)) return(numeric(0))
+  as.numeric(strsplit(txt, " ", fixed = TRUE)[[1L]])
+}
+
+#' Model structure as one data.frame row per node (internal + leaf)
+#' @export
+lgb.model.dt.tree <- function(booster = NULL, model_str = NULL) {
+  if (is.null(model_str)) model_str <- lgb.model.to.string(booster)
+  out <- list()
+  for (ti in seq_along(blocks <- .lgbmtpu_tree_blocks(model_str))) {
+    b <- blocks[[ti]]
+    nl <- as.integer(.lgbmtpu_field(b, "num_leaves"))
+    split_feature <- as.integer(.lgbmtpu_field(b, "split_feature"))
+    threshold <- .lgbmtpu_field(b, "threshold")
+    split_gain <- .lgbmtpu_field(b, "split_gain")
+    internal_count <- .lgbmtpu_field(b, "internal_count")
+    leaf_value <- .lgbmtpu_field(b, "leaf_value")
+    leaf_count <- .lgbmtpu_field(b, "leaf_count")
+    ni <- max(nl - 1L, 0L)
+    if (ni > 0L) {
+      out[[length(out) + 1L]] <- data.frame(
+        tree_index = ti - 1L,
+        node_type = "internal",
+        node_index = seq_len(ni) - 1L,
+        split_feature = split_feature[seq_len(ni)],
+        threshold = threshold[seq_len(ni)],
+        split_gain = split_gain[seq_len(ni)],
+        count = if (length(internal_count)) internal_count[seq_len(ni)]
+                else NA_real_,
+        value = NA_real_,
+        stringsAsFactors = FALSE)
+    }
+    out[[length(out) + 1L]] <- data.frame(
+      tree_index = ti - 1L,
+      node_type = "leaf",
+      node_index = seq_len(max(nl, 1L)) - 1L,
+      split_feature = NA_integer_,
+      threshold = NA_real_,
+      split_gain = NA_real_,
+      count = if (length(leaf_count)) leaf_count[seq_len(max(nl, 1L))]
+              else NA_real_,
+      value = leaf_value[seq_len(max(nl, 1L))],
+      stringsAsFactors = FALSE)
+  }
+  do.call(rbind, out)
+}

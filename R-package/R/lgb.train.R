@@ -1,0 +1,77 @@
+# Training loop with validation sets, callbacks and early stopping —
+# role of the reference R-package/R/lgb.train.R + callback.R plumbing,
+# running fully in-process over the C ABI.
+
+#' Train a model
+#' @param params named list (objective, num_leaves, learning_rate, metric...)
+#' @param data lgb.Dataset
+#' @param nrounds boosting iterations
+#' @param valids named list of lgb.Dataset validation sets
+#' @param early_stopping_rounds stop when no valid metric improves this long
+#' @param callbacks list of callback closures, see callback.R
+#' @export
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), early_stopping_rounds = NULL,
+                      callbacks = list(), verbose = 1L, ...) {
+  params <- c(params, list(...))
+  if (!.lgbmtpu_glue_loaded()) {
+    return(.lgbmtpu_cli_train(params, data, nrounds, valids))
+  }
+  bst <- lgb.Booster(data, params)
+  for (nm in names(valids)) {
+    valids[[nm]]$reference <- data
+    .Call("R_lgbmtpu_booster_add_valid", bst$handle,
+          .lgbmtpu_construct(valids[[nm]]), PACKAGE = "lightgbm_tpu")
+  }
+  if (!is.null(early_stopping_rounds)) {
+    callbacks <- c(callbacks, list(cb_early_stop(early_stopping_rounds)))
+  }
+  if (verbose > 0L) {
+    callbacks <- c(callbacks, list(cb_print_evaluation()))
+  }
+  callbacks <- c(callbacks, list(cb_record_evaluation()))
+  env <- new.env()
+  env$booster <- bst
+  env$valid_names <- names(valids)
+  env$stop <- FALSE
+  for (i in seq_len(nrounds)) {
+    finished <- lgb.update(bst)
+    env$iter <- i
+    env$evals <- lapply(seq_along(valids), function(j) {
+      lgb.eval(bst, j)
+    })
+    names(env$evals) <- names(valids)
+    for (cb in callbacks) cb(env)
+    if (isTRUE(finished) || env$stop) break
+  }
+  bst$record_evals <- env$record
+  bst
+}
+
+#' Cross validation (lgb.cv role): k-fold in-process training
+#' @export
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
+                   verbose = 0L, ...) {
+  if (is.character(data$data)) {
+    stop("lgb.cv needs an in-memory matrix Dataset")
+  }
+  m <- as.matrix(data$data)
+  y <- data$label
+  n <- nrow(m)
+  folds <- split(sample.int(n), rep_len(seq_len(nfold), n))
+  boosters <- vector("list", nfold)
+  scores <- vector("list", nfold)
+  for (k in seq_len(nfold)) {
+    te <- folds[[k]]
+    tr <- setdiff(seq_len(n), te)
+    dtr <- lgb.Dataset(m[tr, , drop = FALSE], label = y[tr],
+                       params = data$params)
+    dte <- lgb.Dataset.create.valid(dtr, m[te, , drop = FALSE],
+                                    label = y[te])
+    boosters[[k]] <- lgb.train(params, dtr, nrounds,
+                               valids = list(test = dte), verbose = verbose)
+    ev <- boosters[[k]]$record_evals[["test"]]
+    scores[[k]] <- if (is.null(ev)) numeric(0) else ev[[length(ev)]]
+  }
+  structure(list(boosters = boosters, scores = scores), class = "lgb.CVBooster")
+}

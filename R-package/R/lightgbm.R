@@ -1,14 +1,15 @@
-# R interface to the lightgbm_tpu framework.
+# CLI fallback layer + the `lightgbm()` convenience wrapper.
 #
-# Mirrors the reference R package's main API (R-package/R/lgb.train.R,
-# lgb.Dataset.R, lgb.cv.R, lgb.Booster.R) over the framework's CLI and
-# reference-format text models instead of per-call C glue: each call writes a
-# train.conf-style config and invokes `python -m lightgbm_tpu`.  See
-# DESCRIPTION for the rationale.
+# The primary binding is IN-PROCESS over the C ABI (src/lightgbm_tpu_R.c
+# against lib_lightgbm_tpu.so, the role of the reference's lightgbm_R.cpp
+# glue).  When the compiled glue is unavailable (e.g. the package sources
+# are used without installation) every entry point falls back to driving
+# the framework CLI (`python -m lightgbm_tpu`) with reference-format
+# config files; models round-trip through the reference text format either
+# way.  Set LIGHTGBM_TPU_PYTHON if the interpreter is not `python3`.
 
 .lgb_python <- function() {
-  p <- Sys.getenv("LIGHTGBM_TPU_PYTHON", "python3")
-  p
+  Sys.getenv("LIGHTGBM_TPU_PYTHON", "python3")
 }
 
 .lgb_cli <- function(args, conf_lines, workdir) {
@@ -34,112 +35,62 @@
 
 .lgb_write_matrix <- function(data, label, path) {
   # label first, tab-separated — the CLI's default label_column=0 layout
-  stopifnot(is.matrix(data) || is.data.frame(data))
   m <- as.matrix(data)
   if (is.null(label)) label <- rep(0, nrow(m))
   utils::write.table(cbind(label, m), path, sep = "\t",
                      row.names = FALSE, col.names = FALSE)
 }
 
-#' Create a dataset for lightgbm.tpu training.
-#'
-#' @param data a numeric matrix/data.frame, or a path to a data file in any
-#'   format the CLI loader reads (CSV/TSV/LibSVM).
-#' @param label response vector (ignored when data is a file path).
-#' @param weight optional per-row weights.
-#' @param group optional query sizes for ranking objectives.
-lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
-                        params = list()) {
-  ds <- list(params = params)
-  if (is.character(data)) {
-    ds$file <- data
-    ds$owned <- FALSE
-  } else {
-    dir <- tempfile("lgb_tpu_ds_")
-    dir.create(dir)
-    ds$file <- file.path(dir, "data.train")
-    .lgb_write_matrix(data, label, ds$file)
-    if (!is.null(weight)) {
-      writeLines(format(weight, scientific = FALSE),
-                 paste0(ds$file, ".weight"))
-    }
-    if (!is.null(group)) {
-      writeLines(format(as.integer(group)), paste0(ds$file, ".query"))
-    }
-    ds$owned <- TRUE
+.lgbmtpu_ds_file <- function(ds, workdir) {
+  # materialize an lgb.Dataset (env, see lgb.Dataset.R) as a CLI data file
+  if (is.character(ds$data)) return(normalizePath(ds$data))
+  path <- file.path(workdir, basename(tempfile("data_")))
+  .lgb_write_matrix(ds$data, ds$label, path)
+  if (!is.null(ds$weight)) {
+    writeLines(format(ds$weight, scientific = FALSE),
+               paste0(path, ".weight"))
   }
-  class(ds) <- "lgb.Dataset"
-  ds
+  if (!is.null(ds$group)) {
+    writeLines(format(as.integer(ds$group)), paste0(path, ".query"))
+  }
+  path
 }
 
-.lgb_booster <- function(model_file) {
-  stopifnot(file.exists(model_file))
-  b <- list(model_file = model_file,
-            model_str = paste(readLines(model_file), collapse = "\n"))
-  class(b) <- "lgb.Booster"
-  b
-}
-
-#' Train a gradient-boosted model (reference lgb.train counterpart).
-lgb.train <- function(params = list(), data, nrounds = 100L,
-                      valids = list(), verbose = 1L) {
-  stopifnot(inherits(data, "lgb.Dataset"))
+.lgbmtpu_cli_train <- function(params, data, nrounds, valids = list()) {
   workdir <- tempfile("lgb_tpu_run_")
   dir.create(workdir)
   model_file <- file.path(workdir, "model.txt")
   conf <- c("task = train",
-            paste0("data = ", normalizePath(data$file)),
+            paste0("data = ", .lgbmtpu_ds_file(data, workdir)),
             paste0("num_iterations = ", as.integer(nrounds)),
             paste0("output_model = ", model_file),
             .lgb_params_to_conf(c(data$params, params)))
   if (length(valids)) {
-    vfiles <- vapply(valids, function(v) normalizePath(v$file), character(1))
+    vfiles <- vapply(valids, function(v) .lgbmtpu_ds_file(v, workdir),
+                     character(1))
     conf <- c(conf, paste0("valid_data = ", paste(vfiles, collapse = ",")))
   }
   log <- .lgb_cli(character(0), conf, workdir)
-  if (verbose > 0) cat(paste(log, collapse = "\n"), "\n")
-  booster <- .lgb_booster(model_file)
-  booster$train_log <- log
-  booster
+  bst <- new.env(parent = emptyenv())
+  bst$handle <- NULL
+  bst$params <- params
+  bst$best_iter <- -1L
+  bst$model_file <- model_file
+  bst$model_str <- paste(readLines(model_file), collapse = "\n")
+  bst$train_log <- log
+  class(bst) <- "lgb.Booster"
+  bst
 }
 
-#' Simple interface (reference `lightgbm()` convenience wrapper).
-lightgbm <- function(data, label = NULL, params = list(), nrounds = 100L,
-                     verbose = 1L) {
-  lgb.train(params, lgb.Dataset(data, label = label), nrounds,
-            verbose = verbose)
-}
-
-#' k-fold cross validation (reference lgb.cv counterpart).
-lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
-                   verbose = 1L) {
-  stopifnot(inherits(data, "lgb.Dataset"), data$owned)
-  rows <- utils::read.table(data$file, sep = "\t")
-  n <- nrow(rows)
-  folds <- sample(rep_len(seq_len(nfold), n))
-  boosters <- vector("list", nfold)
-  for (k in seq_len(nfold)) {
-    dir <- tempfile("lgb_tpu_cv_")
-    dir.create(dir)
-    trf <- file.path(dir, "fold.train")
-    vaf <- file.path(dir, "fold.valid")
-    utils::write.table(rows[folds != k, ], trf, sep = "\t",
-                       row.names = FALSE, col.names = FALSE)
-    utils::write.table(rows[folds == k, ], vaf, sep = "\t",
-                       row.names = FALSE, col.names = FALSE)
-    tr <- lgb.Dataset(trf, params = data$params)
-    va <- lgb.Dataset(vaf, params = data$params)
-    boosters[[k]] <- lgb.train(params, tr, nrounds, valids = list(va),
-                               verbose = verbose)
-  }
-  structure(list(boosters = boosters, folds = folds), class = "lgb.CVBooster")
-}
-
-#' Predict with a trained booster.
-predict.lgb.Booster <- function(object, data, rawscore = FALSE,
-                                predleaf = FALSE, predcontrib = FALSE, ...) {
+.lgbmtpu_cli_predict <- function(object, data, rawscore = FALSE,
+                                 predleaf = FALSE, predcontrib = FALSE,
+                                 num_iteration = -1L) {
   workdir <- tempfile("lgb_tpu_pred_")
   dir.create(workdir)
+  if (is.null(object$model_file) || !file.exists(object$model_file)) {
+    object$model_file <- file.path(workdir, "model.txt")
+    writeLines(object$model_str, object$model_file)
+  }
   if (is.character(data)) {
     dfile <- normalizePath(data)
   } else {
@@ -151,6 +102,8 @@ predict.lgb.Booster <- function(object, data, rawscore = FALSE,
             paste0("data = ", dfile),
             paste0("input_model = ", normalizePath(object$model_file)),
             paste0("output_result = ", result),
+            if (num_iteration > 0)
+              paste0("num_iteration_predict = ", as.integer(num_iteration)),
             if (rawscore) "predict_raw_score = true",
             if (predleaf) "predict_leaf_index = true",
             if (predcontrib) "predict_contrib = true")
@@ -159,32 +112,34 @@ predict.lgb.Booster <- function(object, data, rawscore = FALSE,
   if (ncol(pred) == 1) pred[[1]] else as.matrix(pred)
 }
 
-#' Save a booster to the reference text-model format.
-lgb.save <- function(booster, filename) {
-  stopifnot(inherits(booster, "lgb.Booster"))
+.lgbmtpu_cli_save <- function(booster, filename) {
   writeLines(booster$model_str, filename)
   invisible(booster)
 }
 
-#' Load a booster from a reference-format model file.
-lgb.load <- function(filename) .lgb_booster(filename)
-
-#' Split-count feature importance parsed from the model text.
-lgb.importance <- function(booster) {
-  stopifnot(inherits(booster, "lgb.Booster"))
-  lines <- strsplit(booster$model_str, "\n")[[1]]
-  feats <- strsplit(sub("^feature_names=", "",
-                        grep("^feature_names=", lines, value = TRUE)), " ")[[1]]
-  counts <- integer(length(feats))
-  for (ln in grep("^split_feature=", lines, value = TRUE)) {
-    idx <- as.integer(strsplit(sub("^split_feature=", "", ln), " ")[[1]])
-    for (i in idx) counts[i + 1] <- counts[i + 1] + 1L
-  }
-  data.frame(Feature = feats, SplitCount = counts)
+.lgbmtpu_cli_load <- function(model_str) {
+  bst <- new.env(parent = emptyenv())
+  bst$handle <- NULL
+  bst$params <- list()
+  bst$best_iter <- -1L
+  bst$model_str <- model_str
+  class(bst) <- "lgb.Booster"
+  bst
 }
 
+#' Simple interface (reference `lightgbm()` convenience wrapper)
+#' @export
+lightgbm <- function(data, label = NULL, params = list(), nrounds = 100L,
+                     verbose = 1L) {
+  lgb.train(params, lgb.Dataset(data, label = label), nrounds,
+            verbose = verbose)
+}
+
+#' @export
 print.lgb.Booster <- function(x, ...) {
-  ntrees <- length(grep("^Tree=", strsplit(x$model_str, "\n")[[1]]))
-  cat(sprintf("<lgb.Booster: %d trees, model %s>\n", ntrees, x$model_file))
+  ms <- if (!is.null(x$model_str)) x$model_str
+        else lgb.model.to.string(x)
+  ntrees <- length(grep("^Tree=", strsplit(ms, "\n")[[1]]))
+  cat(sprintf("<lgb.Booster: %d trees>\n", ntrees))
   invisible(x)
 }

@@ -1,0 +1,25 @@
+# RDS round-trip — role of the reference's saveRDS/readRDS.lgb.Booster.R:
+# external-pointer handles do not survive serialization, so the model text
+# is captured into the object before saveRDS and the handle is restored
+# from it after readRDS.
+
+#' @export
+saveRDS.lgb.Booster <- function(object, file, ...) {
+  payload <- list(model_str = lgb.model.to.string(object),
+                  params = object$params,
+                  best_iter = object$best_iter,
+                  record_evals = object$record_evals)
+  class(payload) <- "lgb.Booster.rds"
+  saveRDS(payload, file, ...)
+}
+
+#' @export
+readRDS.lgb.Booster <- function(file, ...) {
+  payload <- readRDS(file, ...)
+  stopifnot(inherits(payload, "lgb.Booster.rds"))
+  bst <- lgb.load(model_str = payload$model_str)
+  bst$params <- payload$params
+  bst$best_iter <- payload$best_iter
+  bst$record_evals <- payload$record_evals
+  bst
+}

@@ -1,0 +1,276 @@
+/* .Call glue between R and lib_lightgbm_tpu.so's LGBM_* C ABI.
+ *
+ * Role of the reference's R-package/src/lightgbm_R.cpp, written as plain C
+ * against the subset of the ABI the R entry points need: dataset from
+ * matrix/file, booster lifecycle, training updates, prediction, model text
+ * round-trip and eval results.  Handles live in R external pointers with
+ * finalizers, so Datasets/Boosters are garbage-collected like any R object.
+ *
+ * Build: R CMD INSTALL compiles this against lib_lightgbm_tpu.so (built by
+ * `python tools/build_capi.py R-package/inst/lib`); see src/Makevars.
+ */
+#include <stdint.h>
+#include <string.h>
+
+#include <R.h>
+#include <Rinternals.h>
+
+typedef void *DatasetHandle;
+typedef void *BoosterHandle;
+
+extern const char *LGBM_GetLastError(void);
+extern int LGBM_DatasetCreateFromMat(const void *data, int data_type,
+                                     int32_t nrow, int32_t ncol,
+                                     int is_row_major, const char *parameters,
+                                     const DatasetHandle reference,
+                                     DatasetHandle *out);
+extern int LGBM_DatasetCreateFromFile(const char *filename,
+                                      const char *parameters,
+                                      const DatasetHandle reference,
+                                      DatasetHandle *out);
+extern int LGBM_DatasetSetField(DatasetHandle handle, const char *field_name,
+                                const void *field_data, int num_element,
+                                int type);
+extern int LGBM_DatasetFree(DatasetHandle handle);
+extern int LGBM_BoosterCreate(const DatasetHandle train_data,
+                              const char *parameters, BoosterHandle *out);
+extern int LGBM_BoosterFree(BoosterHandle handle);
+extern int LGBM_BoosterAddValidData(BoosterHandle handle,
+                                    const DatasetHandle valid_data);
+extern int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int *is_finished);
+extern int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                               int *out_len, double *out_results);
+extern int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int *out_len);
+extern int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                           int *out_iteration);
+extern int LGBM_BoosterPredictForMat(BoosterHandle handle, const void *data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int is_row_major,
+                                     int predict_type, int num_iteration,
+                                     const char *parameter, int64_t *out_len,
+                                     double *out_result);
+extern int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                                      int predict_type, int num_iteration,
+                                      int64_t *out_len);
+extern int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                                 int num_iteration, const char *filename);
+extern int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                         int start_iteration,
+                                         int num_iteration,
+                                         int64_t buffer_len, int64_t *out_len,
+                                         char *out_str);
+extern int LGBM_BoosterLoadModelFromString(const char *model_str,
+                                           int *out_num_iterations,
+                                           BoosterHandle *out);
+extern int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                         int num_iteration,
+                                         int importance_type,
+                                         double *out_results);
+extern int LGBM_BoosterGetNumFeature(BoosterHandle handle, int *out_len);
+
+#define C_API_DTYPE_FLOAT64 1
+#define C_API_FIELD_FLOAT32 0
+
+static void check(int rc, const char *what) {
+  if (rc != 0) {
+    error("lightgbm.tpu %s failed: %s", what, LGBM_GetLastError());
+  }
+}
+
+static void dataset_finalizer(SEXP ptr) {
+  DatasetHandle h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    LGBM_DatasetFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void booster_finalizer(SEXP ptr) {
+  BoosterHandle h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    LGBM_BoosterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static SEXP wrap_handle(void *h, R_CFinalizer_t fin) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP R_lgbmtpu_dataset_from_mat(SEXP data, SEXP nrow, SEXP ncol, SEXP params,
+                                SEXP reference) {
+  DatasetHandle ref = Rf_isNull(reference) ? NULL
+                                           : R_ExternalPtrAddr(reference);
+  DatasetHandle out = NULL;
+  /* R matrices are column-major -> is_row_major = 0 */
+  check(LGBM_DatasetCreateFromMat(REAL(data), C_API_DTYPE_FLOAT64,
+                                  Rf_asInteger(nrow), Rf_asInteger(ncol), 0,
+                                  CHAR(Rf_asChar(params)), ref, &out),
+        "DatasetCreateFromMat");
+  return wrap_handle(out, dataset_finalizer);
+}
+
+SEXP R_lgbmtpu_dataset_from_file(SEXP filename, SEXP params, SEXP reference) {
+  DatasetHandle ref = Rf_isNull(reference) ? NULL
+                                           : R_ExternalPtrAddr(reference);
+  DatasetHandle out = NULL;
+  check(LGBM_DatasetCreateFromFile(CHAR(Rf_asChar(filename)),
+                                   CHAR(Rf_asChar(params)), ref, &out),
+        "DatasetCreateFromFile");
+  return wrap_handle(out, dataset_finalizer);
+}
+
+SEXP R_lgbmtpu_dataset_set_field(SEXP handle, SEXP name, SEXP values) {
+  int n = Rf_length(values);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  double *src = REAL(values);
+  for (int i = 0; i < n; i++) buf[i] = (float)src[i];
+  check(LGBM_DatasetSetField(R_ExternalPtrAddr(handle),
+                             CHAR(Rf_asChar(name)), buf, n,
+                             C_API_FIELD_FLOAT32),
+        "DatasetSetField");
+  return R_NilValue;
+}
+
+SEXP R_lgbmtpu_booster_create(SEXP train, SEXP params) {
+  BoosterHandle out = NULL;
+  check(LGBM_BoosterCreate(R_ExternalPtrAddr(train),
+                           CHAR(Rf_asChar(params)), &out),
+        "BoosterCreate");
+  return wrap_handle(out, booster_finalizer);
+}
+
+SEXP R_lgbmtpu_booster_add_valid(SEXP handle, SEXP valid) {
+  check(LGBM_BoosterAddValidData(R_ExternalPtrAddr(handle),
+                                 R_ExternalPtrAddr(valid)),
+        "BoosterAddValidData");
+  return R_NilValue;
+}
+
+SEXP R_lgbmtpu_booster_update(SEXP handle) {
+  int finished = 0;
+  check(LGBM_BoosterUpdateOneIter(R_ExternalPtrAddr(handle), &finished),
+        "BoosterUpdateOneIter");
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP R_lgbmtpu_booster_cur_iter(SEXP handle) {
+  int it = 0;
+  check(LGBM_BoosterGetCurrentIteration(R_ExternalPtrAddr(handle), &it),
+        "BoosterGetCurrentIteration");
+  return Rf_ScalarInteger(it);
+}
+
+SEXP R_lgbmtpu_booster_eval(SEXP handle, SEXP data_idx) {
+  int n = 0;
+  check(LGBM_BoosterGetEvalCounts(R_ExternalPtrAddr(handle), &n),
+        "BoosterGetEvalCounts");
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  int out_len = 0;
+  check(LGBM_BoosterGetEval(R_ExternalPtrAddr(handle),
+                            Rf_asInteger(data_idx), &out_len, REAL(out)),
+        "BoosterGetEval");
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP R_lgbmtpu_booster_predict_mat(SEXP handle, SEXP data, SEXP nrow,
+                                   SEXP ncol, SEXP predict_type,
+                                   SEXP num_iteration, SEXP params) {
+  int nr = Rf_asInteger(nrow);
+  int64_t want = 0;
+  check(LGBM_BoosterCalcNumPredict(R_ExternalPtrAddr(handle), nr,
+                                   Rf_asInteger(predict_type),
+                                   Rf_asInteger(num_iteration), &want),
+        "BoosterCalcNumPredict");
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)want));
+  int64_t out_len = 0;
+  check(LGBM_BoosterPredictForMat(R_ExternalPtrAddr(handle), REAL(data),
+                                  C_API_DTYPE_FLOAT64, nr,
+                                  Rf_asInteger(ncol), 0,
+                                  Rf_asInteger(predict_type),
+                                  Rf_asInteger(num_iteration),
+                                  CHAR(Rf_asChar(params)), &out_len,
+                                  REAL(out)),
+        "BoosterPredictForMat");
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP R_lgbmtpu_booster_save(SEXP handle, SEXP filename, SEXP num_iteration) {
+  check(LGBM_BoosterSaveModel(R_ExternalPtrAddr(handle), 0,
+                              Rf_asInteger(num_iteration),
+                              CHAR(Rf_asChar(filename))),
+        "BoosterSaveModel");
+  return R_NilValue;
+}
+
+SEXP R_lgbmtpu_booster_to_string(SEXP handle, SEXP num_iteration) {
+  int64_t out_len = 0;
+  check(LGBM_BoosterSaveModelToString(R_ExternalPtrAddr(handle), 0,
+                                      Rf_asInteger(num_iteration), 0,
+                                      &out_len, NULL),
+        "BoosterSaveModelToString(size)");
+  char *buf = (char *)R_alloc((size_t)out_len + 1, 1);
+  check(LGBM_BoosterSaveModelToString(R_ExternalPtrAddr(handle), 0,
+                                      Rf_asInteger(num_iteration),
+                                      out_len + 1, &out_len, buf),
+        "BoosterSaveModelToString");
+  return Rf_mkString(buf);
+}
+
+SEXP R_lgbmtpu_booster_from_string(SEXP model_str) {
+  int iters = 0;
+  BoosterHandle out = NULL;
+  check(LGBM_BoosterLoadModelFromString(CHAR(Rf_asChar(model_str)), &iters,
+                                        &out),
+        "BoosterLoadModelFromString");
+  SEXP ptr = PROTECT(wrap_handle(out, booster_finalizer));
+  SEXP res = PROTECT(Rf_allocVector(VECSXP, 2));
+  SET_VECTOR_ELT(res, 0, ptr);
+  SET_VECTOR_ELT(res, 1, Rf_ScalarInteger(iters));
+  UNPROTECT(2);
+  return res;
+}
+
+SEXP R_lgbmtpu_booster_importance(SEXP handle, SEXP num_iteration,
+                                  SEXP importance_type) {
+  int nfeat = 0;
+  check(LGBM_BoosterGetNumFeature(R_ExternalPtrAddr(handle), &nfeat),
+        "BoosterGetNumFeature");
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, nfeat));
+  check(LGBM_BoosterFeatureImportance(R_ExternalPtrAddr(handle),
+                                      Rf_asInteger(num_iteration),
+                                      Rf_asInteger(importance_type),
+                                      REAL(out)),
+        "BoosterFeatureImportance");
+  UNPROTECT(1);
+  return out;
+}
+
+static const R_CallMethodDef CallEntries[] = {
+    {"R_lgbmtpu_dataset_from_mat", (DL_FUNC)&R_lgbmtpu_dataset_from_mat, 5},
+    {"R_lgbmtpu_dataset_from_file", (DL_FUNC)&R_lgbmtpu_dataset_from_file, 3},
+    {"R_lgbmtpu_dataset_set_field", (DL_FUNC)&R_lgbmtpu_dataset_set_field, 3},
+    {"R_lgbmtpu_booster_create", (DL_FUNC)&R_lgbmtpu_booster_create, 2},
+    {"R_lgbmtpu_booster_add_valid", (DL_FUNC)&R_lgbmtpu_booster_add_valid, 2},
+    {"R_lgbmtpu_booster_update", (DL_FUNC)&R_lgbmtpu_booster_update, 1},
+    {"R_lgbmtpu_booster_cur_iter", (DL_FUNC)&R_lgbmtpu_booster_cur_iter, 1},
+    {"R_lgbmtpu_booster_eval", (DL_FUNC)&R_lgbmtpu_booster_eval, 2},
+    {"R_lgbmtpu_booster_predict_mat",
+     (DL_FUNC)&R_lgbmtpu_booster_predict_mat, 7},
+    {"R_lgbmtpu_booster_save", (DL_FUNC)&R_lgbmtpu_booster_save, 3},
+    {"R_lgbmtpu_booster_to_string", (DL_FUNC)&R_lgbmtpu_booster_to_string, 2},
+    {"R_lgbmtpu_booster_from_string",
+     (DL_FUNC)&R_lgbmtpu_booster_from_string, 1},
+    {"R_lgbmtpu_booster_importance",
+     (DL_FUNC)&R_lgbmtpu_booster_importance, 3},
+    {NULL, NULL, 0}};
+
+void R_init_lightgbm_tpu(DllInfo *dll) {
+  R_registerRoutines(dll, NULL, CallEntries, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

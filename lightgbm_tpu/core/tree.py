@@ -317,11 +317,15 @@ class Tree:
                 next_one_portion = tmp - out[i][3] * zfr * (n - i) / (n + 1)
             else:
                 out[i][3] = out[i][3] * (n + 1) / (zfr * (n - i))
-        out.pop(path_index)
-        for i in range(path_index, len(out)):
-            out[i][0] = path[i + 1][0]
-            out[i][1] = path[i + 1][1]
-            out[i][2] = path[i + 1][2]
+        # recomputed pweights stay AT THEIR INDEX; only the identity fields
+        # (feature, zero/one fractions) shift down past the removed entry —
+        # popping the entry itself would also shift the pweights and break
+        # the local-accuracy (sum-to-raw-score) property
+        for i in range(path_index, n):
+            out[i][0] = out[i + 1][0]
+            out[i][1] = out[i + 1][1]
+            out[i][2] = out[i + 1][2]
+        out.pop()
         return out
 
     @staticmethod

@@ -346,24 +346,40 @@ class DatasetLoader:
         q: "queue.Queue" = queue.Queue(maxsize=depth)
         sentinel = object()
         err = []
+        dead = threading.Event()
 
         def worker():
             try:
                 for item in iterator:
-                    q.put(item)
+                    while not dead.is_set():
+                        try:
+                            q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if dead.is_set():
+                        return
             except BaseException as exc:  # noqa: BLE001 - re-raised below
                 err.append(exc)
             finally:
-                q.put(sentinel)
+                try:
+                    q.put(sentinel, timeout=0.2)
+                except queue.Full:
+                    pass
 
         threading.Thread(target=worker, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # consumer abandoned the generator (or raised): unblock and stop
+            # the worker so the underlying file handle is released
+            dead.set()
 
     def _load_two_round(self, filename: str, rank: int = 0,
                         num_machines: int = 1,

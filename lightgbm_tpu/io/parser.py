@@ -259,200 +259,6 @@ def sample_stream(path: str, sample_cnt: int, seed: int = 1,
     total = 0
 
     if fmt == "libsvm":
-        return _parse_libsvm(path, label_idx)
-    lines = _sniff_lines(path, 1)
-    hdr = _has_header(lines[0], sep) if header is None else header
-    names = None
-    try:
-        import pandas as pd
-        df = pd.read_csv(path, sep=sep, header=0 if hdr else None,
-                         dtype=np.float64 if not hdr else None,
-                         na_values=["", "NA", "N/A", "nan", "NaN", "null"])
-        if hdr:
-            names = [str(c) for c in df.columns]
-        mat = df.to_numpy(dtype=np.float64)
-    except ImportError:
-        skip = 1 if hdr else 0
-        if hdr:
-            names = lines[0].split(sep)
-        mat = np.loadtxt(path, delimiter=sep if sep != " " else None,
-                         skiprows=skip, dtype=np.float64, ndmin=2)
-    if label_idx < 0:
-        return mat, np.zeros(len(mat)), names
-    label = mat[:, label_idx].copy()
-    feats = np.delete(mat, label_idx, axis=1)
-    if names is not None:
-        names = [n for i, n in enumerate(names) if i != label_idx]
-    return feats, label, names
-
-
-def _parse_libsvm(path: str, label_idx: int
-                  ) -> Tuple[np.ndarray, np.ndarray, None]:
-    labels: List[float] = []
-    rows: List[List[Tuple[int, float]]] = []
-    max_idx = -1
-    with open_file(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            toks = line.split()
-            start = 0
-            lab = 0.0
-            if label_idx >= 0 and toks and ":" not in toks[0]:
-                lab = float(toks[0])
-                start = 1
-            pairs = []
-            for tok in toks[start:]:
-                if ":" not in tok:
-                    continue
-                i, v = tok.split(":", 1)
-                i = int(i)
-                pairs.append((i, float(v)))
-                max_idx = max(max_idx, i)
-            labels.append(lab)
-            rows.append(pairs)
-    mat = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
-    for r, pairs in enumerate(rows):
-        for i, v in pairs:
-            mat[r, i] = v
-    return mat, np.asarray(labels), None
-
-
-# ---- streaming (two_round) readers --------------------------------------
-# Counterparts of the reference's sampling/streaming text pipeline
-# (src/io/dataset_loader.cpp:819 SampleTextDataFromFile + the two_round
-# re-read, utils/pipeline_reader.h): pass 1 reservoir-samples rows while
-# counting them; pass 2 re-reads the file in bounded chunks.
-
-
-_NA_TOKENS = {"", "NA", "N/A", "nan", "NaN", "null"}
-
-
-def sniff_header(path: str):
-    """(has_header, column names or None) using the same detection as
-    parse_file."""
-    fmt, sep = detect_format(path)
-    if fmt == "libsvm":
-        return False, None
-    first = _sniff_lines(path, 1)[0]
-    if not _has_header(first, sep):
-        return False, None
-    return True, [c.strip() for c in first.split(sep)]
-
-
-def stream_file(path: str, chunk_rows: int = 65536,
-                header: "Optional[bool]" = None,
-                num_cols: "Optional[int]" = None):
-    """Yield [m, D] float64 chunks of a text data file (m <= chunk_rows).
-
-    For CSV/TSV, D is the file's column count (label still embedded).  For
-    LibSVM, the leading label is column 0 and features occupy columns
-    1..num_cols (``num_cols`` from a prior sampling pass is required so
-    chunk widths agree)."""
-    fmt, sep = detect_format(path)
-    if fmt == "libsvm":
-        if num_cols is None:
-            raise ValueError("LibSVM streaming needs num_cols from the "
-                             "sampling pass")
-        buf_rows: List[List[Tuple[int, float]]] = []
-        labels: List[float] = []
-
-        def flush():
-            mat = np.zeros((len(buf_rows), num_cols + 1), dtype=np.float64)
-            mat[:, 0] = labels
-            for r, pairs in enumerate(buf_rows):
-                for i, v in pairs:
-                    if i < num_cols:
-                        mat[r, i + 1] = v
-            return mat
-
-        with open_file(path) as fh:
-            for line in fh:
-                toks = line.split()
-                if not toks:
-                    continue
-                start = 0
-                lab = 0.0
-                if ":" not in toks[0]:
-                    lab = float(toks[0])
-                    start = 1
-                labels.append(lab)
-                buf_rows.append([(int(t.split(":", 1)[0]),
-                                  float(t.split(":", 1)[1]))
-                                 for t in toks[start:] if ":" in t])
-                if len(buf_rows) >= chunk_rows:
-                    yield flush()
-                    buf_rows, labels = [], []
-        if buf_rows:
-            yield flush()
-        return
-
-    lines = _sniff_lines(path, 1)
-    hdr = _has_header(lines[0], sep) if header is None else header
-    try:
-        import pandas as pd
-        import contextlib
-        # registered schemes (hdfs:// etc.) go through open_file; plain local
-        # paths are handed to pandas directly so its C reader owns the file
-        src_cm = (open_file(path) if "://" in path
-                  else contextlib.nullcontext(path))
-        with src_cm as src:
-            reader = pd.read_csv(
-                src, sep=sep, header=0 if hdr else None,
-                dtype=np.float64 if not hdr else None,
-                na_values=["", "NA", "N/A", "nan", "NaN", "null"],
-                chunksize=chunk_rows)
-            for df in reader:
-                yield df.to_numpy(dtype=np.float64)
-    except ImportError:
-        with open_file(path) as fh:
-            if hdr:
-                fh.readline()
-            rows = []
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                rows.append([float("nan") if t in _NA_TOKENS else float(t)
-                             for t in line.split(sep)])
-                if len(rows) >= chunk_rows:
-                    yield np.asarray(rows, dtype=np.float64)
-                    rows = []
-            if rows:
-                yield np.asarray(rows, dtype=np.float64)
-
-
-def sample_stream(path: str, sample_cnt: int, seed: int = 1,
-                  chunk_rows: int = 65536, header: "Optional[bool]" = None):
-    """Pass 1: stream the file once, reservoir-sampling ``sample_cnt`` rows.
-
-    Returns (sample [k, D] float64, total_rows, num_cols) where num_cols for
-    LibSVM is the max feature index + 1 (label at column 0 like the CSV
-    layout stream_file produces)."""
-    fmt, sep = detect_format(path)
-    rng = np.random.RandomState(seed)
-    total = 0
-
-    def offer(chunk):
-        """Vectorized reservoir step (Algorithm R): row at global position i
-        (1-based) replaces a random slot with probability k/i."""
-        nonlocal total
-        m = chunk.shape[0]
-        take = min(max(sample_cnt - len(sample), 0), m)
-        # .copy(): keeping views would pin every streamed chunk in memory,
-        # defeating the two_round loader's O(sample + chunk) footprint
-        for r in range(take):
-            sample.append(chunk[r].copy())
-        if take < m:
-            pos = total + np.arange(take + 1, m + 1)   # 1-based global index
-            js = (rng.random_sample(m - take) * pos).astype(np.int64)
-            acc = np.flatnonzero(js < sample_cnt)
-            for r in acc:           # few acceptances once the reservoir fills
-                sample[js[r]] = chunk[take + r].copy()
-        total += m
-
-    if fmt == "libsvm":
         # single pass: reservoir-sample RAW lines while tracking the width,
         # parse the sampled lines at the end (two file reads total incl. the
         # fill pass, like the reference's sample + re-read)

@@ -1,0 +1,131 @@
+"""Drive lib_lightgbm_tpu.so through ctypes with EXACTLY the call sequence
+the R glue (R-package/src/lightgbm_tpu_R.c) performs.
+
+No R runtime exists in this environment, so this is the executable pin for
+the R binding: same ABI, same argument conventions (column-major matrices,
+f32 label fields, size-then-fill model strings), same order.  Skipped when
+cffi cannot build the embedded library.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO_DIR = "/tmp/lgbm_tpu_capi_test"
+
+
+@pytest.fixture(scope="module")
+def lib():
+    so = os.path.join(SO_DIR, "lib_lightgbm_tpu.so")
+    if not os.path.exists(so):
+        os.makedirs(SO_DIR, exist_ok=True)
+        try:
+            subprocess.run([sys.executable,
+                            os.path.join(REPO, "tools", "build_capi.py"),
+                            SO_DIR], check=True, capture_output=True,
+                           timeout=420)
+        except Exception as exc:  # noqa: BLE001
+            pytest.skip("C ABI library build unavailable: %s" % exc)
+    return ctypes.CDLL(so)
+
+
+def test_r_glue_call_sequence(lib):
+    rng = np.random.RandomState(0)
+    n, f = 600, 5
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(np.float64)
+
+    # R matrices arrive column-major (is_row_major = 0)
+    colmajor = np.asfortranarray(X)
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromMat(
+        colmajor.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(0),
+        b"max_bin=63", None, ctypes.byref(ds))
+    assert rc == 0, ctypes.string_at(lib.LGBM_GetLastError())
+    lab = y.astype(np.float32)
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", lab.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)) == 0
+
+    booster = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 learning_rate=0.2 "
+            b"metric=binary_logloss", ctypes.byref(booster)) == 0
+
+    vds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        colmajor.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(0),
+        b"max_bin=63", ds, ctypes.byref(vds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        vds, b"label", lab.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)) == 0
+    assert lib.LGBM_BoosterAddValidData(booster, vds) == 0
+
+    fin = ctypes.c_int(0)
+    for _ in range(10):
+        assert lib.LGBM_BoosterUpdateOneIter(booster, ctypes.byref(fin)) == 0
+
+    neval = ctypes.c_int(0)
+    assert lib.LGBM_BoosterGetEvalCounts(booster, ctypes.byref(neval)) == 0
+    out = (ctypes.c_double * max(neval.value, 1))()
+    got = ctypes.c_int(0)
+    assert lib.LGBM_BoosterGetEval(booster, 1, ctypes.byref(got), out) == 0
+    assert got.value == neval.value and out[0] > 0
+
+    def predict(ptype):
+        want = ctypes.c_int64(0)
+        assert lib.LGBM_BoosterCalcNumPredict(
+            booster, ctypes.c_int(n), ctypes.c_int(ptype), ctypes.c_int(-1),
+            ctypes.byref(want)) == 0
+        res = (ctypes.c_double * want.value)()
+        out_len = ctypes.c_int64(0)
+        assert lib.LGBM_BoosterPredictForMat(
+            booster, colmajor.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(1), ctypes.c_int32(n), ctypes.c_int32(f),
+            ctypes.c_int(0), ctypes.c_int(ptype), ctypes.c_int(-1), b"",
+            ctypes.byref(out_len), res) == 0
+        return np.asarray(res).reshape(n, -1)
+
+    prob = predict(0)[:, 0]
+    raw = predict(1)[:, 0]
+    contrib = predict(3)
+    assert contrib.shape == (n, f + 1)
+    acc = np.mean((prob > 0.5) == (y > 0.5))
+    assert acc > 0.8, acc
+    assert np.corrcoef(prob, raw)[0, 1] > 0.99
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-3,
+                               atol=1e-3)
+
+    # size-then-fill model string, reload, importance — the R glue's order
+    out_len = ctypes.c_int64(0)
+    assert lib.LGBM_BoosterSaveModelToString(
+        booster, 0, -1, ctypes.c_int64(0), ctypes.byref(out_len), None) == 0
+    buf = ctypes.create_string_buffer(out_len.value + 1)
+    assert lib.LGBM_BoosterSaveModelToString(
+        booster, 0, -1, ctypes.c_int64(out_len.value + 1),
+        ctypes.byref(out_len), buf) == 0
+    model_str = buf.value
+    assert b"Tree=0" in model_str
+
+    iters = ctypes.c_int(0)
+    b2 = ctypes.c_void_p()
+    assert lib.LGBM_BoosterLoadModelFromString(
+        model_str, ctypes.byref(iters), ctypes.byref(b2)) == 0
+    assert iters.value == 10
+
+    nfeat = ctypes.c_int(0)
+    assert lib.LGBM_BoosterGetNumFeature(booster, ctypes.byref(nfeat)) == 0
+    imp = (ctypes.c_double * nfeat.value)()
+    assert lib.LGBM_BoosterFeatureImportance(booster, -1, 1, imp) == 0
+    assert np.argmax(np.asarray(imp)) in (0, 1)
+
+    assert lib.LGBM_BoosterFree(b2) == 0
+    assert lib.LGBM_BoosterFree(booster) == 0
+    assert lib.LGBM_DatasetFree(vds) == 0
+    assert lib.LGBM_DatasetFree(ds) == 0
