@@ -3,15 +3,30 @@
 # uses the C ABI; otherwise it is computed from the model text via
 # lgb.model.dt.tree (same numbers the reference derives from its dump).
 
+.lgbmtpu_feature_names <- function(booster, model_str = NULL) {
+  ms <- if (!is.null(model_str)) model_str else lgb.model.to.string(booster)
+  ln <- grep("^feature_names=", strsplit(ms, "\n")[[1L]], value = TRUE)
+  if (length(ln) == 0L) return(NULL)
+  strsplit(sub("^feature_names=", "", ln[1L]), " ")[[1L]]
+}
+
+.lgbmtpu_name_or_col <- function(names, idx0) {
+  ifelse(!is.na(idx0) & idx0 + 1L <= length(names) & length(names) > 0L,
+         names[idx0 + 1L], paste0("Column_", idx0))
+}
+
 #' @param importance_type "gain" or "split"
 #' @export
 lgb.importance <- function(booster = NULL, model_str = NULL,
                            percentage = TRUE) {
+  feats <- .lgbmtpu_feature_names(booster, model_str)
   if (!is.null(booster) && .lgbmtpu_glue_loaded()
       && !is.null(booster$handle)) {
     gain <- lgb.feature.importance.raw(booster, importance_type = 1L)
     split <- lgb.feature.importance.raw(booster, importance_type = 0L)
-    df <- data.frame(Feature = paste0("Column_", seq_along(gain) - 1L),
+    nm <- if (!is.null(feats) && length(feats) == length(gain)) feats
+          else paste0("Column_", seq_along(gain) - 1L)
+    df <- data.frame(Feature = nm,
                      Gain = gain, Cover = NA_real_, Frequency = split,
                      stringsAsFactors = FALSE)
   } else {
@@ -23,8 +38,9 @@ lgb.importance <- function(booster = NULL, model_str = NULL,
     }
     gain <- tapply(internal$split_gain, internal$split_feature, sum)
     freq <- tapply(rep(1, nrow(internal)), internal$split_feature, sum)
-    feats <- as.integer(names(gain))
-    df <- data.frame(Feature = paste0("Column_", feats),
+    idx0 <- as.integer(names(gain))
+    df <- data.frame(Feature = .lgbmtpu_name_or_col(
+                       if (is.null(feats)) character(0) else feats, idx0),
                      Gain = as.numeric(gain), Cover = NA_real_,
                      Frequency = as.numeric(freq), stringsAsFactors = FALSE)
   }

@@ -15,6 +15,10 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
                       callbacks = list(), verbose = 1L, ...) {
   params <- c(params, list(...))
   if (!.lgbmtpu_glue_loaded()) {
+    if (!is.null(early_stopping_rounds) || length(callbacks)) {
+      warning("compiled glue not loaded: early_stopping_rounds and ",
+              "callbacks are not supported by the CLI fallback")
+    }
     return(.lgbmtpu_cli_train(params, data, nrounds, valids))
   }
   bst <- lgb.Booster(data, params)

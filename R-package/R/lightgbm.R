@@ -71,14 +71,10 @@
     conf <- c(conf, paste0("valid_data = ", paste(vfiles, collapse = ",")))
   }
   log <- .lgb_cli(character(0), conf, workdir)
-  bst <- new.env(parent = emptyenv())
-  bst$handle <- NULL
-  bst$params <- params
-  bst$best_iter <- -1L
+  bst <- .lgbmtpu_new_booster(NULL, params)
   bst$model_file <- model_file
   bst$model_str <- paste(readLines(model_file), collapse = "\n")
   bst$train_log <- log
-  class(bst) <- "lgb.Booster"
   bst
 }
 
@@ -118,12 +114,8 @@
 }
 
 .lgbmtpu_cli_load <- function(model_str) {
-  bst <- new.env(parent = emptyenv())
-  bst$handle <- NULL
-  bst$params <- list()
-  bst$best_iter <- -1L
+  bst <- .lgbmtpu_new_booster(NULL)
   bst$model_str <- model_str
-  class(bst) <- "lgb.Booster"
   bst
 }
 

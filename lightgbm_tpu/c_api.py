@@ -428,13 +428,14 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
 
     @export("LGBM_DatasetGetFeatureNames")
     def _(handle, feature_names, num_feature_names):
+        # copy-into-caller-buffers semantics; see _copy_names below
         c = _get(_opt_handle(handle))
         names = c.ds.get_feature_name()
         num_feature_names[0] = len(names)
-        bufs = [ffi.new("char[]", n.encode("utf-8")) for n in names]
-        keepalive["feature_names_%d" % _opt_handle(handle)] = bufs
-        for i, b in enumerate(bufs):
-            feature_names[i] = b
+        if feature_names != ffi.NULL:
+            for i, n in enumerate(names):
+                raw = n.encode("utf-8") + b"\0"
+                ffi.memmove(feature_names[i], raw, len(raw))
 
     @export("LGBM_DatasetFree")
     def _(handle):
@@ -607,25 +608,27 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
     def _(handle, out_len):
         out_len[0] = len(_eval_names(_get(_opt_handle(handle))))
 
+    def _copy_names(names, out_len, out_strs):
+        # reference ABI semantics (c_api.cpp GetEvalNames/GetFeatureNames):
+        # the CALLER allocates the per-name buffers (conventionally 128
+        # bytes) and the library COPIES the full NUL-terminated name into
+        # them, exactly like the reference's memcpy.  Replacing the pointers
+        # instead made callers free() library-owned memory (crashed the
+        # SWIG helpers).
+        out_len[0] = len(names)
+        for i, n in enumerate(names):
+            raw = n.encode("utf-8") + b"\0"
+            ffi.memmove(out_strs[i], raw, len(raw))
+
     @export("LGBM_BoosterGetEvalNames")
     def _(handle, out_len, out_strs):
-        cb = _get(_opt_handle(handle))
-        names = _eval_names(cb)
-        out_len[0] = len(names)
-        bufs = [ffi.new("char[]", n.encode("utf-8")) for n in names]
-        keepalive["eval_names_%d" % _opt_handle(handle)] = bufs
-        for i, b in enumerate(bufs):
-            out_strs[i] = b
+        _copy_names(_eval_names(_get(_opt_handle(handle))), out_len,
+                    out_strs)
 
     @export("LGBM_BoosterGetFeatureNames")
     def _(handle, out_len, out_strs):
-        cb = _get(_opt_handle(handle))
-        names = cb.booster.feature_name()
-        out_len[0] = len(names)
-        bufs = [ffi.new("char[]", n.encode("utf-8")) for n in names]
-        keepalive["bfeature_names_%d" % _opt_handle(handle)] = bufs
-        for i, b in enumerate(bufs):
-            out_strs[i] = b
+        _copy_names(_get(_opt_handle(handle)).booster.feature_name(),
+                    out_len, out_strs)
 
     @export("LGBM_BoosterGetNumFeature")
     def _(handle, out_len):

@@ -362,10 +362,12 @@ class DatasetLoader:
             except BaseException as exc:  # noqa: BLE001 - re-raised below
                 err.append(exc)
             finally:
-                try:
-                    q.put(sentinel, timeout=0.2)
-                except queue.Full:
-                    pass
+                while not dead.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
 
         threading.Thread(target=worker, daemon=True).start()
         try:

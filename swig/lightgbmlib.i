@@ -1,8 +1,10 @@
 /* SWIG interface for the lightgbm_tpu C ABI (the role of the reference's
  * swig/lightgbmlib.i for lib_lightgbm: a Java binding over the C API, used
- * by JVM callers such as MMLSpark).  Generate the header first:
+ * by JVM callers such as MMLSpark).  Executed smoke: tools/swig_smoke.py
+ * generates the Java binding AND builds+drives a Python wrap of this same
+ * interface end-to-end (no JDK is needed for the latter).  Manual Java
+ * build:
  *     python tools/build_capi.py swig/
- * then:
  *     swig -java -package io.lightgbm_tpu -outdir java swig/lightgbmlib.i
  *     gcc -shared -fPIC lightgbmlib_wrap.c -I$JAVA_HOME/include \
  *         -I$JAVA_HOME/include/linux -L. -l_lightgbm_tpu -o liblightgbmlib.so
@@ -29,3 +31,142 @@
 %pointer_functions(void*, voidpp)
 
 %include "lightgbm_tpu_c_api.h"
+
+/* ---- char** STRING_ARRAY: Java String[] <-> C string arrays ------------ */
+#ifdef SWIGJAVA
+%typemap(jni) char **STRING_ARRAY "jobjectArray"
+%typemap(jtype) char **STRING_ARRAY "String[]"
+%typemap(jstype) char **STRING_ARRAY "String[]"
+%typemap(javain) char **STRING_ARRAY "$javainput"
+%typemap(in) char **STRING_ARRAY {
+  if ($input) {
+    jsize n = (*jenv)->GetArrayLength(jenv, $input);
+    jsize i;
+    $1 = (char **)malloc((n + 1) * sizeof(char *));
+    for (i = 0; i < n; i++) {
+      jstring s = (jstring)(*jenv)->GetObjectArrayElement(jenv, $input, i);
+      const char *c = (*jenv)->GetStringUTFChars(jenv, s, 0);
+      $1[i] = strdup(c);
+      (*jenv)->ReleaseStringUTFChars(jenv, s, c);
+      (*jenv)->DeleteLocalRef(jenv, s);
+    }
+    $1[n] = 0;
+  } else {
+    $1 = 0;
+  }
+}
+%typemap(freearg) char **STRING_ARRAY {
+  if ($1) {
+    char **p;
+    for (p = $1; *p; p++) free(*p);
+    free($1);
+  }
+}
+%apply char **STRING_ARRAY { const char **feature_names }
+#endif
+
+/* ---- string-returning convenience wrappers ----------------------------- */
+/* The raw size-then-fill ABI calls are awkward from JVM/Python callers;
+ * these helpers own the two-phase dance and hand back one malloc'd string
+ * (%newobject: the target language frees it). */
+%newobject LGBM_BoosterSaveModelToStringSWIG;
+%newobject LGBM_BoosterDumpModelSWIG;
+%newobject LGBM_BoosterGetEvalNamesSWIG;
+%newobject LGBM_DatasetGetFeatureNamesSWIG;
+%inline %{
+static char *lgbmtpu_two_phase_(void *handle, int start_iteration,
+                                int num_iteration,
+                                int (*fn)(void *, int, int, int64_t,
+                                          int64_t *, char *)) {
+  int64_t out_len = 0;
+  char *buf;
+  if (fn(handle, start_iteration, num_iteration, 0, &out_len, NULL) != 0) {
+    return NULL;
+  }
+  buf = (char *)malloc((size_t)out_len + 1);
+  if (!buf) return NULL;
+  if (fn(handle, start_iteration, num_iteration, out_len + 1, &out_len,
+         buf) != 0) {
+    free(buf);
+    return NULL;
+  }
+  return buf;
+}
+
+char *LGBM_BoosterSaveModelToStringSWIG(BoosterHandle handle,
+                                        int start_iteration,
+                                        int num_iteration) {
+  return lgbmtpu_two_phase_(handle, start_iteration, num_iteration,
+                            (int (*)(void *, int, int, int64_t, int64_t *,
+                                     char *))LGBM_BoosterSaveModelToString);
+}
+
+char *LGBM_BoosterDumpModelSWIG(BoosterHandle handle, int start_iteration,
+                                int num_iteration) {
+  return lgbmtpu_two_phase_(handle, start_iteration, num_iteration,
+                            (int (*)(void *, int, int, int64_t, int64_t *,
+                                     char *))LGBM_BoosterDumpModel);
+}
+
+/* newline-joined eval/feature names (the reference exposes String[] via its
+ * typemaps; a joined string keeps the helper language-agnostic) */
+static char *lgbmtpu_join_names_(int n, char **names) {
+  size_t total = 0;
+  int i;
+  char *out, *w;
+  for (i = 0; i < n; i++) total += strlen(names[i]) + 1;
+  out = (char *)malloc(total + 1);
+  if (!out) return NULL;
+  w = out;
+  for (i = 0; i < n; i++) {
+    size_t L = strlen(names[i]);
+    memcpy(w, names[i], L);
+    w += L;
+    *w++ = (i + 1 < n) ? '\n' : '\0';
+  }
+  if (n == 0) *w = '\0';
+  return out;
+}
+
+static char *lgbmtpu_names_(int n, int bufsize,
+                            int (*fill)(void *, int *, char **),
+                            void *handle) {
+  char **names, *out;
+  int i, got = n;
+  if (n <= 0) return strdup("");
+  names = (char **)malloc(n * sizeof(char *));
+  for (i = 0; i < n; i++) names[i] = (char *)malloc(bufsize);
+  if (fill(handle, &got, names) != 0 || got > n) {
+    out = NULL;
+  } else {
+    out = lgbmtpu_join_names_(got, names);
+  }
+  for (i = 0; i < n; i++) free(names[i]);
+  free(names);
+  return out;
+}
+
+char *LGBM_BoosterGetEvalNamesSWIG(BoosterHandle handle) {
+  int n = 0;
+  if (LGBM_BoosterGetEvalCounts(handle, &n) != 0) return NULL;
+  return lgbmtpu_names_(n, 128,
+                        (int (*)(void *, int *, char **))
+                            LGBM_BoosterGetEvalNames,
+                        handle);
+}
+
+/* LGBM_DatasetGetFeatureNames has (handle, names, num) argument order --
+ * the reverse of the booster getters -- so adapt it to the shared shape */
+static int lgbmtpu_ds_featnames_fill_(void *h, int *n, char **names) {
+  return LGBM_DatasetGetFeatureNames(h, names, n);
+}
+
+char *LGBM_DatasetGetFeatureNamesSWIG(DatasetHandle handle) {
+  int n = 0;
+  /* count query: the ABI writes the count even with no buffers */
+  if (LGBM_DatasetGetFeatureNames(handle, NULL, &n) != 0 || n <= 0) {
+    return strdup("");
+  }
+  return lgbmtpu_names_(n, 256, lgbmtpu_ds_featnames_fill_, handle);
+}
+%}

@@ -1,0 +1,111 @@
+"""EXECUTED smoke of the SWIG binding (VERDICT r3 item 9: script it, don't
+document it).
+
+1. builds lib_lightgbm_tpu.so + header into a work dir,
+2. runs `swig -java` to validate the Java binding generates (incl. the
+   STRING_ARRAY typemaps and inline helpers),
+3. runs `swig -python`, compiles the wrap against the ABI library (no JDK
+   exists in this environment; the Python wrap exercises the exact same
+   interface file), loads it, and drives dataset -> train -> predict ->
+   SaveModelToStringSWIG end-to-end.
+
+Usage: python tools/swig_smoke.py [workdir]
+"""
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(cmd, **kw):
+    print("+", " ".join(cmd))
+    subprocess.run(cmd, check=True, **kw)
+
+
+def main(workdir):
+    os.makedirs(os.path.join(workdir, "java"), exist_ok=True)
+    run([sys.executable, os.path.join(REPO, "tools", "build_capi.py"),
+         workdir])
+    iface = os.path.join(workdir, "lightgbmlib.i")
+    shutil.copy(os.path.join(REPO, "swig", "lightgbmlib.i"), iface)
+
+    # Java generation (typemaps + helpers must be legal for the JNI target)
+    run(["swig", "-java", "-package", "io.lightgbm_tpu", "-outdir",
+         os.path.join(workdir, "java"), "-o",
+         os.path.join(workdir, "lightgbmlib_java_wrap.c"), iface])
+    gen = os.listdir(os.path.join(workdir, "java"))
+    assert "lightgbmlib.java" in gen, gen
+    wrap = open(os.path.join(workdir, "lightgbmlib_java_wrap.c")).read()
+    assert "LGBM_BoosterSaveModelToStringSWIG" in wrap
+    assert "GetStringUTFChars" in wrap, "STRING_ARRAY typemap not applied"
+
+    # Python wrap: compile + import + drive
+    run(["swig", "-python", "-o",
+         os.path.join(workdir, "lightgbmlib_py_wrap.c"), iface])
+    inc = sysconfig.get_paths()["include"]
+    run(["gcc", "-shared", "-fPIC",
+         os.path.join(workdir, "lightgbmlib_py_wrap.c"),
+         "-I" + inc, "-I" + workdir,
+         "-L" + workdir, "-l_lightgbm_tpu",
+         "-Wl,-rpath," + workdir,
+         "-o", os.path.join(workdir, "_lightgbmlib.so")])
+    sys.path.insert(0, workdir)
+    import lightgbmlib as L  # noqa: E402
+
+    import numpy as np
+    rng = np.random.RandomState(0)
+    n, f = 400, 4
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(np.float64)
+
+    arr = L.new_doubleArray(n * f)
+    for i, v in enumerate(X.ravel()):
+        L.doubleArray_setitem(arr, i, float(v))
+    hptr = L.new_voidpp()
+    rc = L.LGBM_DatasetCreateFromMat(
+        L.doublep_to_voidp(arr) if hasattr(L, "doublep_to_voidp") else arr,
+        1, n, f, 1, "max_bin=31", None, hptr)
+    assert rc == 0, L.LGBM_GetLastError()
+    ds = L.voidpp_value(hptr)
+
+    lab = L.new_floatArray(n)
+    for i, v in enumerate(y):
+        L.floatArray_setitem(lab, i, float(v))
+    assert L.LGBM_DatasetSetField(ds, "label", lab, n, 0) == 0
+
+    bptr = L.new_voidpp()
+    assert L.LGBM_BoosterCreate(
+        ds, "objective=binary num_leaves=7 learning_rate=0.3", bptr) == 0
+    bst = L.voidpp_value(bptr)
+    fin = L.new_intp()
+    for _ in range(5):
+        assert L.LGBM_BoosterUpdateOneIter(bst, fin) == 0
+
+    out_len = L.new_int64p()
+    want = L.new_int64p()
+    assert L.LGBM_BoosterCalcNumPredict(bst, n, 0, -1, want) == 0
+    res = L.new_doubleArray(L.int64p_value(want))
+    assert L.LGBM_BoosterPredictForMat(bst, arr, 1, n, f, 1, 0, -1, "",
+                                       out_len, res) == 0
+    preds = np.asarray([L.doubleArray_getitem(res, i) for i in range(n)])
+    acc = float(np.mean((preds > 0.5) == (y > 0.5)))
+    print("swig-python predict accuracy:", acc)
+    assert acc > 0.8
+
+    model = L.LGBM_BoosterSaveModelToStringSWIG(bst, 0, -1)
+    assert "Tree=0" in model
+    names = L.LGBM_BoosterGetEvalNamesSWIG(bst)
+    print("eval names:", names)
+    feats = L.LGBM_DatasetGetFeatureNamesSWIG(ds)
+    assert feats.count("\n") == f - 1, feats
+    print("feature names:", feats.replace("\n", ","))
+    assert L.LGBM_BoosterFree(bst) == 0
+    assert L.LGBM_DatasetFree(ds) == 0
+    print("SWIG smoke: OK")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/lgbm_tpu_swig_smoke")
