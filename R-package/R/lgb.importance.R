@@ -4,7 +4,17 @@
 # lgb.model.dt.tree (same numbers the reference derives from its dump).
 
 .lgbmtpu_feature_names <- function(booster, model_str = NULL) {
-  ms <- if (!is.null(model_str)) model_str else lgb.model.to.string(booster)
+  if (is.null(model_str) && !is.null(booster)
+      && is.null(booster$model_str) && .lgbmtpu_glue_loaded()
+      && !is.null(booster$handle)) {
+    # in-process: ask the glue instead of serializing the whole model
+    nm <- tryCatch(.Call("R_lgbmtpu_booster_feature_names", booster$handle,
+                         PACKAGE = "lightgbm_tpu"), error = function(e) NULL)
+    if (!is.null(nm)) return(strsplit(nm, "\n")[[1L]])
+  }
+  ms <- if (!is.null(model_str)) model_str
+        else if (!is.null(booster$model_str)) booster$model_str
+        else lgb.model.to.string(booster)
   ln <- grep("^feature_names=", strsplit(ms, "\n")[[1L]], value = TRUE)
   if (length(ln) == 0L) return(NULL)
   strsplit(sub("^feature_names=", "", ln[1L]), " ")[[1L]]

@@ -67,6 +67,8 @@ extern int LGBM_BoosterFeatureImportance(BoosterHandle handle,
                                          int importance_type,
                                          double *out_results);
 extern int LGBM_BoosterGetNumFeature(BoosterHandle handle, int *out_len);
+extern int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int *out_len,
+                                       char **out_strs);
 
 #define C_API_DTYPE_FLOAT64 1
 #define C_API_FIELD_FLOAT32 0
@@ -251,6 +253,33 @@ SEXP R_lgbmtpu_booster_importance(SEXP handle, SEXP num_iteration,
   return out;
 }
 
+SEXP R_lgbmtpu_booster_feature_names(SEXP handle) {
+  int n = 0, i;
+  check(LGBM_BoosterGetNumFeature(R_ExternalPtrAddr(handle), &n),
+        "BoosterGetNumFeature");
+  if (n <= 0) return Rf_mkString("");
+  {
+    char **names = (char **)R_alloc(n, sizeof(char *));
+    size_t total = 0;
+    char *joined, *w;
+    SEXP out;
+    for (i = 0; i < n; i++) names[i] = (char *)R_alloc(128, 1);
+    check(LGBM_BoosterGetFeatureNames(R_ExternalPtrAddr(handle), &n, names),
+          "BoosterGetFeatureNames");
+    for (i = 0; i < n; i++) total += strlen(names[i]) + 1;
+    joined = (char *)R_alloc(total + 1, 1);
+    w = joined;
+    for (i = 0; i < n; i++) {
+      size_t L = strlen(names[i]);
+      memcpy(w, names[i], L);
+      w += L;
+      *w++ = (i + 1 < n) ? '\n' : '\0';
+    }
+    out = Rf_mkString(joined);
+    return out;
+  }
+}
+
 static const R_CallMethodDef CallEntries[] = {
     {"R_lgbmtpu_dataset_from_mat", (DL_FUNC)&R_lgbmtpu_dataset_from_mat, 5},
     {"R_lgbmtpu_dataset_from_file", (DL_FUNC)&R_lgbmtpu_dataset_from_file, 3},
@@ -268,6 +297,8 @@ static const R_CallMethodDef CallEntries[] = {
      (DL_FUNC)&R_lgbmtpu_booster_from_string, 1},
     {"R_lgbmtpu_booster_importance",
      (DL_FUNC)&R_lgbmtpu_booster_importance, 3},
+    {"R_lgbmtpu_booster_feature_names",
+     (DL_FUNC)&R_lgbmtpu_booster_feature_names, 1},
     {NULL, NULL, 0}};
 
 void R_init_lightgbm_tpu(DllInfo *dll) {

@@ -428,14 +428,11 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
 
     @export("LGBM_DatasetGetFeatureNames")
     def _(handle, feature_names, num_feature_names):
-        # copy-into-caller-buffers semantics; see _copy_names below
         c = _get(_opt_handle(handle))
         names = c.ds.get_feature_name()
         num_feature_names[0] = len(names)
         if feature_names != ffi.NULL:
-            for i, n in enumerate(names):
-                raw = n.encode("utf-8") + b"\0"
-                ffi.memmove(feature_names[i], raw, len(raw))
+            _copy_names(names, num_feature_names, feature_names)
 
     @export("LGBM_DatasetFree")
     def _(handle):
@@ -610,14 +607,18 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
 
     def _copy_names(names, out_len, out_strs):
         # reference ABI semantics (c_api.cpp GetEvalNames/GetFeatureNames):
-        # the CALLER allocates the per-name buffers (conventionally 128
-        # bytes) and the library COPIES the full NUL-terminated name into
-        # them, exactly like the reference's memcpy.  Replacing the pointers
-        # instead made callers free() library-owned memory (crashed the
-        # SWIG helpers).
+        # the CALLER allocates the per-name buffers and the library COPIES
+        # NUL-terminated names into them (replacing the pointers instead
+        # made callers free() library-owned memory and crashed the SWIG
+        # helpers).  This ABI version carries no buffer length, so copies
+        # are bounded by the 128-byte buffer convention every known caller
+        # uses (UTF-8-safe truncation).
         out_len[0] = len(names)
         for i, n in enumerate(names):
-            raw = n.encode("utf-8") + b"\0"
+            raw = n.encode("utf-8")[:127]
+            while raw and (raw[-1] & 0xC0) == 0x80:   # don't split a rune
+                raw = raw[:-1]
+            raw += b"\0"
             ffi.memmove(out_strs[i], raw, len(raw))
 
     @export("LGBM_BoosterGetEvalNames")

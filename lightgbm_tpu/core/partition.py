@@ -278,30 +278,6 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 comp_buf[s * 2 * TS:(s + 1) * 2 * TS, :] = comp_f.astype(
                     jnp.int32).astype(jnp.uint8)
 
-            # smaller child's histogram, one pass over the whole chunk
-            # (also overlaps the totals DMA)
-            if "hist" not in dbg_skip:
-                sf = jnp.where(hist_left == 1,
-                               selL_chunk.astype(jnp.float32),
-                               selR_chunk.astype(jnp.float32))
-                g = g_chunk * sf
-                h = h_chunk * sf
-                vals = jnp.concatenate([g, h], axis=1)       # [CHUNK, 2]
-                v4 = _hilo_split(vals, axis=1, exact=exact)
-
-                def colf(f):
-                    if packed:
-                        return (ti_chunk[:, f // 2:f // 2 + 1]
-                                >> (4 * (f % 2))) & 15
-                    if bpc == 2:
-                        return (ti_chunk[:, 2 * f:2 * f + 1]
-                                | (ti_chunk[:, 2 * f + 1:2 * f + 2] << 8))
-                    return ti_chunk[:, f:f + 1]
-
-                _accum_onehot_tiles(colf, v4, hist_ref,
-                                    num_features=num_features,
-                                    num_bins=num_bins, contract_dim=0)
-
             # ---- phase C (scalar-cheap): blends + flushes from SMEM totals
             cpt.wait()
             accL = fillL + totals_sm[1, 2 * nsub - 2]
@@ -421,6 +397,85 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                                         sem_pre)
             cpb.start()
             cpb.wait()
+
+        # ---- smaller child's histogram from its CONTIGUOUS block ----
+        # Post-partition the smaller child is contiguous (left block in
+        # rows_ref, right block in scratch), so the one-hot build — the
+        # dominant elementwise histogram cost, ~f*128 compare-ops per row —
+        # touches only the smaller child's rows, not every window row.
+        if "hist" not in dbg_skip:
+            iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+            bwh = [(iota_lane == off).astype(jnp.bfloat16)
+                   + (iota_lane == off + 1).astype(jnp.bfloat16) * 256
+                   for off in (voff, voff + 2, voff + 4, voff + 6)]
+            wmat_h = jnp.concatenate(bwh, axis=0)            # [4, W]
+            iota_c = jax.lax.broadcasted_iota(jnp.int32, (CHUNK, 1), 0)
+
+            def hist_pass(src_ref, base_al, head, cnt):
+                nh = (head + cnt + CHUNK - 1) // CHUNK
+
+                @pl.when(nh > 0)
+                def _pro():
+                    pltpu.make_async_copy(
+                        src_ref.at[pl.ds(base_al, CHUNK)], inbuf.at[0],
+                        sem_in.at[0]).start()
+
+                def hbody(c, _):
+                    slot = jax.lax.rem(c, 2)
+                    pltpu.make_async_copy(
+                        src_ref.at[pl.ds(
+                            pl.multiple_of(base_al + c * CHUNK, _ALIGN),
+                            CHUNK)],
+                        inbuf.at[slot], sem_in.at[slot]).wait()
+
+                    @pl.when(c + 1 < nh)
+                    def _pre():
+                        nxt = 1 - slot
+                        pltpu.make_async_copy(
+                            src_ref.at[pl.ds(
+                                pl.multiple_of(base_al + (c + 1) * CHUNK,
+                                               _ALIGN), CHUNK)],
+                            inbuf.at[nxt], sem_in.at[nxt]).start()
+
+                    ti_c = inbuf[slot].astype(jnp.int32)
+                    ext_h = jax.lax.dot_general(
+                        ti_c.astype(jnp.bfloat16), wmat_h,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)  # [CHUNK, 4]
+                    exti_h = ext_h.astype(jnp.int32)
+                    g = jax.lax.bitcast_convert_type(
+                        exti_h[:, 0:1] | (exti_h[:, 1:2] << 16), jnp.float32)
+                    h = jax.lax.bitcast_convert_type(
+                        exti_h[:, 2:3] | (exti_h[:, 3:4] << 16), jnp.float32)
+                    pos = c * CHUNK + iota_c
+                    inw = ((pos >= head).astype(jnp.float32)
+                           * (pos < head + cnt).astype(jnp.float32))
+                    vals = jnp.concatenate([g * inw, h * inw], axis=1)
+                    v4 = _hilo_split(vals, axis=1, exact=exact)
+
+                    def colf(f):
+                        if packed:
+                            return (ti_c[:, f // 2:f // 2 + 1]
+                                    >> (4 * (f % 2))) & 15
+                        if bpc == 2:
+                            return (ti_c[:, 2 * f:2 * f + 1]
+                                    | (ti_c[:, 2 * f + 1:2 * f + 2] << 8))
+                        return ti_c[:, f:f + 1]
+
+                    _accum_onehot_tiles(colf, v4, hist_ref,
+                                        num_features=num_features,
+                                        num_bins=num_bins, contract_dim=0)
+                    return 0
+
+                jax.lax.fori_loop(0, nh, hbody, 0)
+
+            @pl.when(hist_left == 1)
+            def _hist_left_block():
+                hist_pass(rows_ref, wb_al, headL, nl)
+
+            @pl.when(hist_left != 1)
+            def _hist_right_block():
+                hist_pass(scratch_ref, 0, 0, nr)
 
         # ---- copy right block back: scratch[0:nr] -> rows[wb+nl ...) ----
         # Same streamed-append machinery (double-buffered reads, NB-deep

@@ -167,6 +167,6 @@ char *LGBM_DatasetGetFeatureNamesSWIG(DatasetHandle handle) {
   if (LGBM_DatasetGetFeatureNames(handle, NULL, &n) != 0 || n <= 0) {
     return strdup("");
   }
-  return lgbmtpu_names_(n, 256, lgbmtpu_ds_featnames_fill_, handle);
+  return lgbmtpu_names_(n, 128, lgbmtpu_ds_featnames_fill_, handle);
 }
 %}

@@ -14,8 +14,9 @@ import pytest
 
 
 def test_swig_binding_end_to_end(tmp_path):
-    if shutil.which("swig") is None:
-        pytest.skip("swig not installed")
+    if shutil.which("swig") is None or shutil.which("gcc") is None:
+        pytest.skip("swig/gcc not installed")
+    pytest.importorskip("cffi")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
         out = subprocess.run(
