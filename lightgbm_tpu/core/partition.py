@@ -185,11 +185,11 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             # serialized the whole pipeline at 6 ns/row).
             ti_chunk = inbuf[slot].astype(jnp.int32)         # [CHUNK, W]
             ti_bf = ti_chunk.astype(jnp.bfloat16)            # hoisted for B
-            # ONE MXU dot extracts the split column and the g/h bytes for the
-            # whole chunk: lane-masked VPU reductions cost ~thousands of
-            # vreg-ops per chunk, a [CHUNK,W]@[W,8] dot ~0.2us.  Byte values
-            # (<=255) are exact in bf16; 16-bit halves keep f32 accumulation
-            # exact; i32 wrap reassembles the sign bit.
+            # ONE MXU dot extracts the split column for the whole chunk:
+            # lane-masked VPU reductions cost ~thousands of vreg-ops per
+            # chunk, a [CHUNK,W]@[W,2] dot ~0.2us (byte values <=255 are
+            # exact in bf16).  The g/h bytes are extracted the same way in
+            # the post-partition histogram pass.
             lanes_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
             if packed:
                 colsel = (lanes_w == gcol // 2).astype(jnp.bfloat16)
@@ -200,13 +200,10 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             else:
                 colsel = (lanes_w == gcol).astype(jnp.bfloat16)
                 colsel2 = jnp.zeros((1, W), jnp.bfloat16)
-            bw = [(lanes_w == off).astype(jnp.bfloat16)
-                  + (lanes_w == off + 1).astype(jnp.bfloat16) * 256
-                  for off in (voff, voff + 2, voff + 4, voff + 6)]
-            wmat = jnp.concatenate([colsel, colsel2] + bw, axis=0)  # [6, W]
+            wmat = jnp.concatenate([colsel, colsel2], axis=0)    # [2, W]
             ext = jax.lax.dot_general(
                 ti_bf, wmat, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [CHUNK, 6]
+                preferred_element_type=jnp.float32)          # [CHUNK, 2]
             exti = ext.astype(jnp.int32)
             if packed:
                 byte = exti[:, 0:1]
@@ -216,14 +213,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 col_chunk = exti[:, 0:1] | (exti[:, 1:2] << 8)
             else:
                 col_chunk = exti[:, 0:1]
-            g_chunk = jax.lax.bitcast_convert_type(
-                exti[:, 2:3] | (exti[:, 3:4] << 16), jnp.float32)
-            h_chunk = jax.lax.bitcast_convert_type(
-                exti[:, 4:5] | (exti[:, 5:6] << 16), jnp.float32)
-            if "route" in dbg_skip:
-                gl_chunk = col_chunk & 1
-            else:
-                gl_chunk = _route_tile(col_chunk, scal_ref, num_bins)
+            gl_chunk = _route_tile(col_chunk, scal_ref, num_bins)
             pos_chunk = abs0 + jax.lax.broadcasted_iota(
                 jnp.int32, (CHUNK, 1), 0)
             inw_chunk = ((pos_chunk >= wb).astype(jnp.int32)
@@ -597,6 +587,9 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
                           packed: bool = False, exact: bool = False,
                           interpret: bool = False, dbg_skip: str = ""):
     """Fused split pass over a combined row store.
+
+    ``dbg_skip``: comma-joined phase knockouts for device profiling only
+    ("hist", "phaseB", "phaseC", "flush") — outputs are WRONG when set.
 
     rows: [N_pad, W] u8 row store, N_pad a multiple of CHUNK.  CONTRACT: the
       caller must keep every window end <= N_pad - CHUNK (the streaming loop
