@@ -38,7 +38,6 @@ def main() -> None:
     leaves = int(os.environ.get("BENCH_LEAVES", 255 if on_tpu else 31))
     max_bin = int(os.environ.get("BENCH_BIN", 63))
     f = 28
-    warmup = 2
 
     from lightgbm_tpu.boosting.gbdt import GBDT
     from lightgbm_tpu.config import Config
@@ -56,7 +55,7 @@ def main() -> None:
 
     ds = BinnedDataset.from_matrix(X, label=y, max_bin=max_bin)
     cfg = Config(objective="binary", num_leaves=leaves,
-                 num_iterations=2 * iters + warmup, learning_rate=0.1,
+                 num_iterations=2 * iters, learning_rate=0.1,
                  max_bin=max_bin)
     booster = GBDT(cfg, ds, create_objective("binary", cfg))
 
@@ -66,8 +65,8 @@ def main() -> None:
         booster.train_score.block_until_ready()
         float(jax.device_get(booster.train_score[0, 0]))
 
-    # warmup compiles both the k=warmup and the k=iters fused programs
-    booster.train_chunk(warmup)
+    # warm up with the SAME k=iters fused program the timed run uses (a
+    # second program size would double the multi-minute 10.5M-row compile)
     booster.train_chunk(iters)
     force_sync()
 
