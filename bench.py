@@ -90,10 +90,19 @@ def main() -> None:
     from lightgbm_tpu.core.partition import TS
     # private-but-shared padding helpers: bench MUST mirror the kernel's own
     # padding rule or the MFU accounting silently diverges from real cost
-    from lightgbm_tpu.core.histogram import _pad_bins_pow2, _padded_features
+    from lightgbm_tpu.core.histogram import (_factored_geometry,
+                                             _hilo_factors, _pad_bins_pow2,
+                                             _padded_features, _use_factored)
     W = 128
     B = _pad_bins_pow2(max_bin + 1)
-    lanes = _padded_features(f, B) * B
+    if _use_factored(f, B):
+        # factored hi/lo path: each group contracts a [128, R] x [R, p*nlo]
+        # all-pairs block (histogram._accum_factored_T)
+        nhi, nlo = _hilo_factors(B)
+        p, G = _factored_geometry(f, B)
+        hist_macs_per_row = G * (4 * p * nhi) * (p * nlo)
+    else:
+        hist_macs_per_row = 4 * _padded_features(f, B) * B
     visits = 0.0
     hist_rows = 0.0
     trees = booster.models[-iters:]
@@ -109,7 +118,8 @@ def main() -> None:
             rcnt = (cnt[r] if r >= 0 else t.leaf_count[~r])
             hist_rows += min(float(lcnt), float(rcnt))
     bytes_moved = visits * W * 2.5 + n * iters * W  # + root hist streams
-    macs = visits * (2 * TS * W) + (hist_rows + n * iters) * 4 * lanes
+    macs = (visits * (2 * TS * W)
+            + (hist_rows + n * iters) * hist_macs_per_row)
     PEAK_BW = 819e9        # v5e HBM GB/s
     PEAK_MACS = 98.5e12    # v5e bf16 (197 TFLOP/s)
     hbm_util = bytes_moved / dt / PEAK_BW

@@ -239,6 +239,176 @@ def histogram_pallas(bins: jax.Array, values: jax.Array, num_bins: int,
                                    interpret=interpret, exact=exact)
 
 
+def _hilo_factors(num_bins: int):
+    """num_bins = nhi * nlo (both powers of two, nlo <= 32): the bin index
+    factors as ``bin = hi * nlo + lo``, so a B-lane one-hot becomes the outer
+    product of an nhi-lane and an nlo-lane one-hot — built with nhi + nlo
+    compares per (row, feature) instead of B, with the outer product riding
+    the histogram contraction itself on the MXU (see _accum_factored_T)."""
+    nlo = 1
+    while nlo * nlo < num_bins:
+        nlo *= 2
+    nlo = min(nlo, 32)
+    return num_bins // nlo, nlo
+
+
+def _factored_geometry(num_features: int, num_bins: int):
+    """(p, G): features per MXU group and group count.  Each group's left
+    operand stacks p features' value-weighted hi one-hots as [p*4*nhi = 128,
+    R]; the right stacks their lo one-hots [p*nlo, R]."""
+    nhi, _ = _hilo_factors(num_bins)
+    p = max(1, _LANE // (4 * nhi))
+    return p, -(-num_features // p)
+
+
+def _use_factored(num_features: int, num_bins: int) -> bool:
+    """The factored path computes a p x p all-pairs block per group (only the
+    diagonal is read), so its MXU cost scales with F^2/p — a win for the
+    narrow-F regime every binned GBDT dataset lives in after EFB, a loss for
+    very wide F.  The 124 bound is that crossover heuristic (and keeps the
+    transposed extraction dot around one 128-row M tile for single-byte
+    codes; bpc=2 builds 2F+4 selector rows, which is still a single valid
+    dot, just M-tiled)."""
+    return 32 <= num_bins and num_features + 4 <= 124
+
+
+def _accum_factored_T(colT_fn, v4T, out_ref, *, num_features: int,
+                      num_bins: int):
+    """Factored-MXU histogram accumulation (transposed layout).
+
+    colT_fn(f) -> [1, R] i32 bin codes of feature f, rows along LANES;
+    v4T: [4, R] (grad_hi, hess_hi, grad_lo, hess_lo), bf16 (or f32 in exact
+    mode); out_ref: [G*128, p*nlo] f32, += accumulated.
+
+    Replaces the classic B-lane one-hot build (B compares + astypes per
+    (row, feature) — linear in B, the dominant VPU cost of the round-4
+    kernel) with nhi + nlo compares and a [128, R] @ [R, p*nlo] MXU
+    contraction whose p x p feature cross-blocks are discarded except the
+    diagonal.  The value weighting rides the hi side (4 channels x nhi
+    sublane-broadcast multiplies).  Cost is near-independent of B: the
+    255-bin headline costs about the same as 63-bin."""
+    nhi, nlo = _hilo_factors(num_bins)
+    p, G = _factored_geometry(num_features, num_bins)
+    R = v4T.shape[1]
+    exact = v4T.dtype == jnp.float32
+    oh_t = v4T.dtype
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (nhi, 1), 0)
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (nlo, 1), 0)
+    sh = nlo.bit_length() - 1
+    for g in range(G):
+        a_blocks = []
+        lo_blocks = []
+        for q in range(p):
+            f = g * p + q
+            if f < num_features:
+                colf = colT_fn(f)                          # [1, R] i32
+                hi_oh = ((colf >> sh) == iota_hi).astype(oh_t)   # [nhi, R]
+                lo_oh = ((colf & (nlo - 1)) == iota_lo).astype(oh_t)
+                for c in range(4):
+                    a_blocks.append(v4T[c:c + 1, :] * hi_oh)
+                lo_blocks.append(lo_oh)
+            else:
+                a_blocks.append(jnp.zeros((4 * nhi, R), oh_t))
+                lo_blocks.append(jnp.zeros((nlo, R), oh_t))
+        a_big = jnp.concatenate(a_blocks, axis=0)          # [p*4*nhi, R]
+        lo_big = jnp.concatenate(lo_blocks, axis=0)        # [p*nlo, R]
+        acc = jax.lax.dot_general(
+            a_big, lo_big, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST if exact else None)
+        rows = a_big.shape[0]
+        out_ref[g * rows:(g + 1) * rows, :] += acc
+
+
+def _fold_factored(raw, num_features: int, num_bins: int):
+    """[G*128, p*nlo] factored accumulator -> [F, 2, B] f32 (grad = hi + lo
+    value channels, hess likewise; bin = hi * nlo + lo)."""
+    nhi, nlo = _hilo_factors(num_bins)
+    p, G = _factored_geometry(num_features, num_bins)
+    d = raw.reshape(G, p, 4, nhi, p, nlo)
+    idx = jnp.arange(p)
+    diag = d[:, idx, :, :, idx, :]          # [p, G, 4, nhi, nlo]
+    h = diag.transpose(1, 0, 2, 3, 4).reshape(G * p, 4, nhi * nlo)
+    h = h[:num_features]
+    return h[:, 0:2, :] + h[:, 2:4, :]
+
+
+def _factored_out_shape(num_features: int, num_bins: int):
+    nhi, nlo = _hilo_factors(num_bins)
+    p, G = _factored_geometry(num_features, num_bins)
+    return (G * p * 4 * nhi, p * nlo)
+
+
+def _extract_T(ti_bf, *, num_features: int, voff: int, bpc: int,
+               packed: bool, exact: bool, inwT=None):
+    """Transposed extraction: bin codes + g/h from a [R, W] bf16 row-store
+    tile in ONE [M, W] @ [R, W]^T dot (byte values are exact in bf16; the
+    g/h f32s are rebuilt from two 16-bit halves so f32 accumulation is
+    exact).  Returns (colT_fn, v4T) for _accum_factored_T.
+
+    Keeping every per-row intermediate LANE-major ([k, R]) matters as much
+    as the dot itself: sliced [R, 1] intermediates are 128x vreg-padded."""
+    R, W = ti_bf.shape
+    f32 = jnp.float32
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    rows = []
+    if packed:
+        for f in range(0, num_features, 2):
+            rows.append((iota_w == f // 2))
+        ncol_rows = len(rows)
+    elif bpc == 2:
+        for f in range(num_features):
+            rows.append((iota_w == 2 * f))
+        for f in range(num_features):
+            rows.append((iota_w == 2 * f + 1))
+        ncol_rows = num_features
+    else:
+        for f in range(num_features):
+            rows.append((iota_w == f))
+        ncol_rows = num_features
+    # g/h as two 16-bit halves each (i32 wrap restores the sign bit; the
+    # OBVIOUS shifted-slice OR chain is miscompiled on v5e — see
+    # _f32_from_bytes)
+    for off in (voff, voff + 2, voff + 4, voff + 6):
+        rows.append((iota_w == off) * 1 + (iota_w == off + 1) * 256)
+    E = jnp.concatenate(rows, axis=0).astype(jnp.bfloat16)   # [M, W]
+    allT = jax.lax.dot_general(
+        E, ti_bf, (((1,), (1,)), ((), ())),
+        preferred_element_type=f32)                          # [M, R]
+    allTi = allT.astype(jnp.int32)
+    nghr = allTi.shape[0] - 4
+    g_w = jax.lax.bitcast_convert_type(
+        allTi[nghr:nghr + 1, :] | (allTi[nghr + 1:nghr + 2, :] << 16), f32)
+    h_w = jax.lax.bitcast_convert_type(
+        allTi[nghr + 2:nghr + 3, :] | (allTi[nghr + 3:nghr + 4, :] << 16),
+        f32)
+    if inwT is not None:
+        g_w = g_w * inwT
+        h_w = h_w * inwT
+    if exact:
+        v4T = jnp.concatenate(
+            [g_w, h_w, jnp.zeros_like(g_w), jnp.zeros_like(h_w)], axis=0)
+    else:
+        g_hi = g_w.astype(jnp.bfloat16)
+        h_hi = h_w.astype(jnp.bfloat16)
+        g_lo = (g_w - g_hi.astype(f32)).astype(jnp.bfloat16)
+        h_lo = (h_w - h_hi.astype(f32)).astype(jnp.bfloat16)
+        v4T = jnp.concatenate([g_hi, h_hi, g_lo, h_lo], axis=0)
+
+    if packed:
+        def colT_fn(f):
+            byte = allTi[f // 2:f // 2 + 1, :]
+            return (byte >> (4 * (f % 2))) & 15
+    elif bpc == 2:
+        def colT_fn(f):
+            return (allTi[f:f + 1, :]
+                    | (allTi[ncol_rows + f:ncol_rows + f + 1, :] << 8))
+    else:
+        def colT_fn(f):
+            return allTi[f:f + 1, :]
+    return colT_fn, v4T
+
+
 def _f32_from_bytes(ti, off: int):
     """Little-endian f32 from 4 byte-lanes of an i32-converted row tile.
 
@@ -299,6 +469,34 @@ def _hist_kernel_rows(win_ref, rows_ref, out_ref, *, num_features: int,
                             num_bins=num_bins, contract_dim=0)
 
 
+def _hist_kernel_rows_fac(win_ref, rows_ref, out_ref, *, num_features: int,
+                          num_bins: int, row_tile: int, packed: bool,
+                          voff: int, bpc: int, exact: bool = False):
+    """Factored-MXU variant of _hist_kernel_rows: transposed extraction +
+    hi/lo outer-product accumulation (see _accum_factored_T).  out_ref:
+    [G*128, p*nlo] f32 — fold with _fold_factored."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    start, count = win_ref[0], win_ref[1]
+    base = i * row_tile
+
+    @pl.when((base < start + count) & (base + row_tile > start))
+    def _accum():
+        ti_bf = rows_ref[...].astype(jnp.int32).astype(jnp.bfloat16)
+        posT = base + jax.lax.broadcasted_iota(jnp.int32, (1, row_tile), 1)
+        inwT = ((posT >= start).astype(jnp.float32)
+                * (posT < start + count).astype(jnp.float32))
+        colT_fn, v4T = _extract_T(ti_bf, num_features=num_features,
+                                  voff=voff, bpc=bpc, packed=packed,
+                                  exact=exact, inwT=inwT)
+        _accum_factored_T(colT_fn, v4T, out_ref,
+                          num_features=num_features, num_bins=num_bins)
+
+
 @functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
                                              "voff", "bpc", "row_tile",
                                              "packed", "interpret", "exact"))
@@ -317,19 +515,39 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
     assert _LANE % num_bins == 0 or num_bins % _LANE == 0, (
         "num_bins must divide or be a multiple of 128 (use _pad_bins_pow2); "
         "got %d" % num_bins)
-    f_pad = _padded_features(num_features, num_bins)
-    lanes = f_pad * num_bins
     win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
-    kernel = functools.partial(_hist_kernel_rows, num_features=num_features,
-                               num_bins=num_bins, row_tile=row_tile,
-                               packed=packed, voff=voff, bpc=bpc,
-                               exact=exact)
 
     def _in_idx(i, win_ref):
         active = ((i * row_tile < win_ref[0] + win_ref[1])
                   & ((i + 1) * row_tile > win_ref[0]))
         return (jnp.where(active, i, 0), 0)
 
+    if _use_factored(num_features, num_bins):
+        out_shape = _factored_out_shape(num_features, num_bins)
+        kernel = functools.partial(
+            _hist_kernel_rows_fac, num_features=num_features,
+            num_bins=num_bins, row_tile=row_tile, packed=packed, voff=voff,
+            bpc=bpc, exact=exact)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n // row_tile,),
+            in_specs=[pl.BlockSpec((row_tile, width), _in_idx)],
+            out_specs=pl.BlockSpec(out_shape, lambda i, w: (0, 0)),
+        )
+        raw = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
+            interpret=interpret,
+        )(win, rows)
+        return _fold_factored(raw, num_features, num_bins)
+
+    f_pad = _padded_features(num_features, num_bins)
+    lanes = f_pad * num_bins
+    kernel = functools.partial(_hist_kernel_rows, num_features=num_features,
+                               num_bins=num_bins, row_tile=row_tile,
+                               packed=packed, voff=voff, bpc=bpc,
+                               exact=exact)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n // row_tile,),

@@ -41,21 +41,32 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .histogram import (_accum_onehot_tiles, _hilo_split, _padded_features,
-                        histogram_xla_masked, rows_split_xla)
+from .histogram import (_accum_factored_T, _accum_onehot_tiles, _extract_T,
+                        _factored_out_shape, _fold_factored, _hilo_split,
+                        _padded_features, _use_factored, histogram_xla_masked,
+                        rows_split_xla)
 
 _LANE = 128
 _ALIGN = 32          # u8 sublane tile: dynamic DMA offsets must be 32-row mult
-CHUNK = 2048         # rows per streamed DMA tile
-T = 256              # rows per placement subtile (one P matmul)
-TS = 256             # staging/flush tile (rows per contiguous write-back)
-NB = 12              # flush-ring depth per stream (>= CHUNK/TS + 2 so a
+CHUNK = 4096         # rows per streamed DMA tile
+T = 128              # rows per placement subtile (one P matmul)
+TS = 128             # staging/flush tile (rows per contiguous write-back)
+# Round-5 (2M-row window, v5e, full-kernel timings — phase knockouts are
+# scheduling-noisy, whole-kernel numbers are stable): the lane-packed
+# phase A/B + factored-MXU histogram rewrite took 9.29 -> 4.6 ns/row at
+# CHUNK=2048; CHUNK=4096 amortizes the per-chunk totals round-trip to
+# 4.12 (8192: 3.98, but doubles the minimum per-split window work that
+# small deep-tree leaves pay).  T=128 halves the placement one-hot vs 256
+# now that dest math is lane-major (the old layout charged small T back
+# in [CHUNK, 1] subtile slicing).
+NB = 36              # flush-ring depth per stream (>= CHUNK/TS + 2 so a
                      # whole chunk can blend before its flushes start)
 # The single-flush circular staging depends on nls <= TS per subtile (at most
 # one stage wrap per append) and the subtile loop covering the chunk exactly;
 # retuning one constant without the other silently corrupts the partition.
 assert T == TS and CHUNK % T == 0 and T % _ALIGN == 0 and TS % _ALIGN == 0
 assert NB * TS >= CHUNK + 2 * TS
+assert 2 * (CHUNK // T) <= 128, "subtile totals must fit one [128, 2] SMEM tile"
 
 
 def _route_tile(col, scal_ref, num_bins):
@@ -119,11 +130,13 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         nchunks = (headL + wc + CHUNK - 1) // CHUNK
 
         hist_ref[...] = jnp.zeros_like(hist_ref)
-        # lower-triangular ones: subtiles are STACKED ALONG N so one
-        # [T,T]@[T,2*nsub] dot computes every subtile's local prefix — a
-        # skinny N=2 prefix matmul is MXU weight-load bound (~2.3us each)
+        # upper-triangular ones U[j, t] = (j <= t): subtiles are STACKED
+        # ALONG M so one [2*nsub, T] @ U dot computes every subtile's local
+        # inclusive prefix lane-major — a skinny N=2 prefix matmul is MXU
+        # weight-load bound (~2.3us each), and sublane-major prefixes would
+        # put every per-row intermediate in 128x-padded [CHUNK, 1] vregs
         ltri[...] = (jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
-                     >= jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+                     <= jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
                      ).astype(jnp.bfloat16)
 
         def left_dst(nf):
@@ -143,7 +156,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 rows_ref.at[pl.ds(wb_al, CHUNK)], inbuf.at[0], sem_in.at[0]
             ).start()
 
-        iota1x2ts = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * TS), 1)
+        iota2ts1 = jax.lax.broadcasted_iota(jnp.int32, (2 * TS, 1), 0)
         iota_ts = jax.lax.broadcasted_iota(jnp.int32, (TS, 1), 0)
 
         def wait_left(m):
@@ -178,18 +191,29 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
             abs0 = wb_al + c * CHUNK
             nsub = CHUNK // T
+            npk = CHUNK // _LANE               # lane-packed rows (row r ->
+                                               # [r // 128, r % 128])
             # ---- phase A (vector): convert, route, per-subtile prefixes.
-            # One u8->i32 conversion, one column extraction, one routing
-            # pass per chunk; per-subtile totals land in SMEM via ONE DMA
-            # (direct vector->scalar extraction costs ~0.7us EACH on v5e and
-            # serialized the whole pipeline at 6 ns/row).
-            ti_chunk = inbuf[slot].astype(jnp.int32)         # [CHUNK, W]
-            ti_bf = ti_chunk.astype(jnp.bfloat16)            # hoisted for B
-            # ONE MXU dot extracts the split column for the whole chunk:
-            # lane-masked VPU reductions cost ~thousands of vreg-ops per
-            # chunk, a [CHUNK,W]@[W,2] dot ~0.2us (byte values <=255 are
-            # exact in bf16).  The g/h bytes are extracted the same way in
-            # the post-partition histogram pass.
+            # EVERY per-row intermediate lives LANE-PACKED as [CHUNK/128, 128]
+            # — [CHUNK, 1]-shaped vectors are 128x vreg-padded on v5e and made
+            # this phase 2.6 ns/row in the round-5 knockout profile (~90% of
+            # phase A); the same math lane-packed is ~30 vregs per chunk.
+            # Per-subtile totals land in SMEM via ONE DMA (direct vector->
+            # scalar extraction costs ~0.7us EACH and does not pipeline).
+            if "convert" in dbg_skip:          # profiling: stream-only floor
+                ti_chunk = jnp.zeros((CHUNK, W), jnp.int32)
+                ti_bf = jnp.zeros((CHUNK, W), jnp.bfloat16)
+            elif "statslot" in dbg_skip:       # profiling: static buffer read
+                ti_chunk = inbuf[0].astype(jnp.int32)
+                ti_bf = ti_chunk.astype(jnp.bfloat16)
+            else:
+                ti_chunk = inbuf[slot].astype(jnp.int32)     # [CHUNK, W]
+                ti_bf = ti_chunk.astype(jnp.bfloat16)        # hoisted for B
+            # ONE MXU dot extracts the split column for the whole chunk —
+            # TRANSPOSED ([2, W] @ [CHUNK, W]^T -> [2, CHUNK]) so the i32
+            # conversion and the packed reshape stay lane-major.  Byte values
+            # <= 255 are exact in bf16; the g/h bytes are extracted the same
+            # way in the post-partition histogram pass.
             lanes_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
             if packed:
                 colsel = (lanes_w == gcol // 2).astype(jnp.bfloat16)
@@ -200,78 +224,102 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             else:
                 colsel = (lanes_w == gcol).astype(jnp.bfloat16)
                 colsel2 = jnp.zeros((1, W), jnp.bfloat16)
-            wmat = jnp.concatenate([colsel, colsel2], axis=0)    # [2, W]
-            ext = jax.lax.dot_general(
-                ti_bf, wmat, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [CHUNK, 2]
-            exti = ext.astype(jnp.int32)
-            if packed:
-                byte = exti[:, 0:1]
-                col_chunk = jnp.where(gcol % 2 == 1, (byte >> 4) & 15,
-                                      byte & 15)
-            elif bpc == 2:
-                col_chunk = exti[:, 0:1] | (exti[:, 1:2] << 8)
+            if "extract" in dbg_skip:          # profiling: no extract/route
+                col_p = jnp.zeros((npk, _LANE), jnp.int32)
             else:
-                col_chunk = exti[:, 0:1]
-            gl_chunk = _route_tile(col_chunk, scal_ref, num_bins)
-            pos_chunk = abs0 + jax.lax.broadcasted_iota(
-                jnp.int32, (CHUNK, 1), 0)
-            inw_chunk = ((pos_chunk >= wb).astype(jnp.int32)
-                         * (pos_chunk < wb + wc).astype(jnp.int32))
-            selL_chunk = gl_chunk * inw_chunk                # i32 0/1
-            selR_chunk = (1 - gl_chunk) * inw_chunk
-            nsub = CHUNK // T
-            # one [T, T]@[T, 2*nsub] dot: subtile s's (selL, selR) occupy
-            # columns (2s, 2s+1); a single fat matmul replaces 8 skinny ones
-            sel_stacked = jnp.concatenate(
-                [jnp.concatenate([selL_chunk[s * T:(s + 1) * T, :],
-                                  selR_chunk[s * T:(s + 1) * T, :]], axis=1)
-                 for s in range(nsub)], axis=1).astype(jnp.float32)
-            pfx16 = jax.lax.dot_general(
-                ltri[...], sel_stacked, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [T, 2*nsub]
-            tot_row = pfx16[T - 1:T, :]                      # [1, 2*nsub]
-            # interleaved per-side cumulative totals (same parity, j <= i)
-            ii16 = jax.lax.broadcasted_iota(jnp.int32, (2 * nsub, 1), 0)
-            jj16 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * nsub), 1)
-            tri16 = ((ii16 >= jj16).astype(jnp.int32)
-                     * (ii16 % 2 == jj16 % 2).astype(jnp.int32)
-                     ).astype(jnp.float32)
-            incl_row = jax.lax.dot_general(
-                tot_row, tri16, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [1, 2*nsub]
-            excl_row = incl_row - tot_row
-            totals_vm[0:1, 0:2 * nsub] = tot_row.astype(jnp.int32)
-            totals_vm[1:2, 0:2 * nsub] = incl_row.astype(jnp.int32)
-            cpt = pltpu.make_async_copy(totals_vm, totals_sm, sem_tot)
-            cpt.start()
+                wmat = jnp.concatenate([colsel, colsel2], axis=0)    # [2, W]
+                extT = jax.lax.dot_general(
+                    wmat, ti_bf, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)      # [2, CHUNK]
+                extTi = extT.astype(jnp.int32)
+                lo_p = extTi[0:1, :].reshape(npk, _LANE)
+                if packed:
+                    col_p = jnp.where(gcol % 2 == 1, (lo_p >> 4) & 15,
+                                      lo_p & 15)
+                elif bpc == 2:
+                    col_p = lo_p | (extTi[1:2, :].reshape(npk, _LANE) << 8)
+                else:
+                    col_p = lo_p
+            gl_p = _route_tile(col_p, scal_ref, num_bins)    # [npk, 128]
+            pos_p = (abs0
+                     + jax.lax.broadcasted_iota(jnp.int32, (npk, 1), 0)
+                     * _LANE
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1))
+            inw_p = ((pos_p >= wb).astype(jnp.int32)
+                     * (pos_p < wb + wc).astype(jnp.int32))
+            selL_p = gl_p * inw_p                            # i32 0/1
+            selR_p = (1 - gl_p) * inw_p
+            # S stacks the selection vectors as [2*nsub, T] LANE-major (row s
+            # = left stream of subtile s, row nsub+s = right): per-subtile
+            # inclusive prefixes are then ONE [2*nsub, T] @ upper-tri[T, T]
+            # MXU dot, and cross-subtile cumulative totals one tiny dot more.
+            assert T % _LANE == 0
+            if T == _LANE:
+                S_L, S_R = selL_p, selR_p
+            else:
+                S_L = selL_p.reshape(nsub, T)
+                S_R = selR_p.reshape(nsub, T)
+            if "prefix" in dbg_skip:           # profiling: no prefix/totals
+                pfxU = jnp.zeros((2 * nsub, T), jnp.float32)
+                excl_col = jnp.zeros((2 * nsub, 1), jnp.float32)
+                cpt = None
+            else:
+                S = jnp.concatenate([S_L, S_R], axis=0).astype(jnp.bfloat16)
+                pfxU = jax.lax.dot_general(
+                    S, ltri[...], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)      # [2*nsub, T]
+                tot_col = pfxU[:, T - 1:T]                   # [2*nsub, 1]
+                # per-side cumulative totals (lower-tri within each block)
+                iiB = jax.lax.broadcasted_iota(jnp.int32, (2 * nsub, 1), 0)
+                jjB = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * nsub), 1)
+                triB = ((iiB >= jjB).astype(jnp.int32)
+                        * ((iiB < nsub) == (jjB < nsub)).astype(jnp.int32)
+                        ).astype(jnp.bfloat16)
+                incl_col = jax.lax.dot_general(
+                    triB, tot_col.astype(jnp.bfloat16),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)      # [2*nsub, 1]
+                excl_col = incl_col - tot_col
+                if "totals" in dbg_skip:       # profiling: no totals DMA
+                    cpt = None
+                else:
+                    totals_vm[0:2 * nsub, 0:1] = tot_col.astype(jnp.int32)
+                    totals_vm[0:2 * nsub, 1:2] = incl_col.astype(jnp.int32)
+                    cpt = pltpu.make_async_copy(totals_vm, totals_sm,
+                                                sem_tot)
+                    cpt.start()
 
             # ---- phase B (vector, overlaps the totals DMA): place every
-            # subtile into comp_buf; dest positions are pure vector math
-            # (chunk-base fill scalars broadcast + vector exclusive bases)
+            # subtile into comp_buf.  The placement one-hot is built
+            # TRANSPOSED — dest as a [1, T] lane vector against a [2TS, 1]
+            # iota — so the dest math is lane-packed too; the [2TS, T] @
+            # [T, W] dot then lands rows directly in staging order.
             for s in range(nsub) if "phaseB" not in dbg_skip else []:
-                selL = selL_chunk[s * T:(s + 1) * T, :]
-                selR = selR_chunk[s * T:(s + 1) * T, :]
-                pfxL = pfx16[:, 2 * s:2 * s + 1].astype(jnp.int32)
-                pfxR = pfx16[:, 2 * s + 1:2 * s + 2].astype(jnp.int32)
-                bL = excl_row[0:1, 2 * s:2 * s + 1].astype(jnp.int32)
-                bR = excl_row[0:1, 2 * s + 1:2 * s + 2].astype(jnp.int32)
-                destL = jax.lax.rem(headL + fillL + bL + pfxL - 1, TS)
-                destR = TS + jax.lax.rem(fillR + bR + pfxR - 1, TS)
-                dest = jnp.where(selL == 1, destL,
-                                 jnp.where(selR == 1, destR, 2 * TS))
-                Pt = (dest == iota1x2ts).astype(jnp.bfloat16)    # [T, 2TS]
+                selLs = S_L[s:s + 1, :]                      # [1, T] i32
+                selRs = S_R[s:s + 1, :]
+                pfxLs = pfxU[s:s + 1, :].astype(jnp.int32)   # [1, T]
+                pfxRs = pfxU[nsub + s:nsub + s + 1, :].astype(jnp.int32)
+                bL = excl_col[s:s + 1, 0:1].astype(jnp.int32)
+                bR = excl_col[nsub + s:nsub + s + 1, 0:1].astype(jnp.int32)
+                destL = jax.lax.rem(headL + fillL + bL + pfxLs - 1, TS)
+                destR = TS + jax.lax.rem(fillR + bR + pfxRs - 1, TS)
+                dest = jnp.where(selLs == 1, destL,
+                                 jnp.where(selRs == 1, destR, 2 * TS))
+                Pt = (dest == iota2ts1).astype(jnp.bfloat16)     # [2TS, T]
                 comp_f = jax.lax.dot_general(
                     Pt, ti_bf[s * T:(s + 1) * T, :],
-                    (((0,), (0,)), ((), ())),
+                    (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)          # [2TS, W]
                 comp_buf[s * 2 * TS:(s + 1) * 2 * TS, :] = comp_f.astype(
                     jnp.int32).astype(jnp.uint8)
 
             # ---- phase C (scalar-cheap): blends + flushes from SMEM totals
-            cpt.wait()
-            accL = fillL + totals_sm[1, 2 * nsub - 2]
-            accR = fillR + totals_sm[1, 2 * nsub - 1]
+            if cpt is None:                    # "prefix" knockout (profiling)
+                accL, accR = fillL, fillR
+            else:
+                cpt.wait()
+                accL = fillL + totals_sm[nsub - 1, 1]
+                accR = fillR + totals_sm[2 * nsub - 1, 1]
             k1L = (headL + accL) // TS       # stream tiles complete after c
             k1R = accR // TS
 
@@ -287,10 +335,10 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             for s in range(nsub) if "phaseC" not in dbg_skip else []:
                 compL = comp_buf[s * 2 * TS:s * 2 * TS + TS, :]
                 compR = comp_buf[s * 2 * TS + TS:(s + 1) * 2 * TS, :]
-                nls = totals_sm[0, 2 * s]
-                nrs = totals_sm[0, 2 * s + 1]
-                baseL = fillL + totals_sm[1, 2 * s] - nls
-                baseR = fillR + totals_sm[1, 2 * s + 1] - nrs
+                nls = totals_sm[s, 0]
+                nrs = totals_sm[nsub + s, 0]
+                baseL = fillL + totals_sm[s, 1] - nls
+                baseR = fillR + totals_sm[nsub + s, 1] - nrs
                 startL = jax.lax.rem(headL + baseL, TS)
                 startR = jax.lax.rem(baseR, TS)
                 curL = jax.lax.rem((headL + baseL) // TS, NB)
@@ -390,10 +438,13 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
         # ---- smaller child's histogram from its CONTIGUOUS block ----
         # Post-partition the smaller child is contiguous (left block in
-        # rows_ref, right block in scratch), so the one-hot build — the
-        # dominant elementwise histogram cost, ~f*128 compare-ops per row —
-        # touches only the smaller child's rows, not every window row.
+        # rows_ref, right block in scratch).  With the factored hi/lo build
+        # (histogram._accum_factored_T) the per-row cost is nhi + nlo
+        # compares per feature instead of B — near-independent of max_bin —
+        # and the outer product rides the MXU contraction; wide-F datasets
+        # fall back to the classic packed one-hot tiles.
         if "hist" not in dbg_skip:
+            factored = _use_factored(num_features, num_bins)
             iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
             bwh = [(iota_lane == off).astype(jnp.bfloat16)
                    + (iota_lane == off + 1).astype(jnp.bfloat16) * 256
@@ -428,6 +479,19 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                             inbuf.at[nxt], sem_in.at[nxt]).start()
 
                     ti_c = inbuf[slot].astype(jnp.int32)
+                    if factored:
+                        ti_bf_h = ti_c.astype(jnp.bfloat16)
+                        posT = (c * CHUNK + jax.lax.broadcasted_iota(
+                            jnp.int32, (1, CHUNK), 1))
+                        inwT = ((posT >= head).astype(jnp.float32)
+                                * (posT < head + cnt).astype(jnp.float32))
+                        colT_fn, v4T = _extract_T(
+                            ti_bf_h, num_features=num_features, voff=voff,
+                            bpc=bpc, packed=packed, exact=exact, inwT=inwT)
+                        _accum_factored_T(colT_fn, v4T, hist_ref,
+                                          num_features=num_features,
+                                          num_bins=num_bins)
+                        return 0
                     ext_h = jax.lax.dot_general(
                         ti_c.astype(jnp.bfloat16), wmat_h,
                         (((1,), (1,)), ((), ())),
@@ -589,7 +653,11 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     """Fused split pass over a combined row store.
 
     ``dbg_skip``: comma-joined phase knockouts for device profiling only
-    ("hist", "phaseB", "phaseC", "flush") — outputs are WRONG when set.
+    ("hist", "phaseB", "phaseC", "flush", "convert", "extract", "prefix",
+    "totals", "statslot") — outputs are WRONG when set ("prefix"/"totals"
+    additionally zero the chunk fill counters, so even row counts lie).
+    Knockout timings are scheduling-sensitive (zeroed inputs constant-fold
+    downstream phases); trust whole-kernel A/B timings over deltas.
 
     rows: [N_pad, W] u8 row store, N_pad a multiple of CHUNK.  CONTRACT: the
       caller must keep every window end <= N_pad - CHUNK (the streaming loop
@@ -600,16 +668,19 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
       is_cat, hist_left_side, use_unfold, efb_offset, *cat_bitset_words).
 
     Returns (rows_new [N_pad, W] u8 — the window stably partitioned in place,
-    hist4 [4, f_pad*num_bins] f32 — smaller child's histogram, hi/lo rows to
-    fold like histogram_pallas_rows, nl [1, 1] i32 — left-child row count).
+    hist_raw f32 — smaller child's histogram in the kernel's accumulator
+    layout (factored [G*128, p*nlo] or classic [4, f_pad*num_bins]; fold
+    with :func:`fold_hist`), nl [1, 1] i32 — left-child row count).
     """
     n_pad, W = rows.shape
     assert n_pad % CHUNK == 0, "pad the row store to a multiple of CHUNK"
     assert num_bins >= 32 and num_bins % 32 == 0, \
         "num_bins must be the >=32 kernel-block width (_pad_bins_pow2); " \
         "nibble-packed 16-bin data still scans at 32 lanes"
-    f_pad = _padded_features(num_features, num_bins)
-    lanes = f_pad * num_bins
+    if _use_factored(num_features, num_bins):
+        hist_shape = _factored_out_shape(num_features, num_bins)
+    else:
+        hist_shape = (4, _padded_features(num_features, num_bins) * num_bins)
     kernel = _make_partition_kernel(
         n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
         voff=voff, bpc=bpc, packed=packed, exact=exact, dbg_skip=dbg_skip)
@@ -630,12 +701,12 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
             scratch_shapes=[
                 pltpu.VMEM((2, CHUNK, W), jnp.uint8),    # streamed chunks
                 pltpu.VMEM((2 * NB, TS, W), jnp.uint8),  # L/R flush rings
-                pltpu.VMEM((T, T), jnp.bfloat16),        # lower-tri ones
+                pltpu.VMEM((T, T), jnp.bfloat16),        # upper-tri prefix ones
                 pltpu.VMEM((TS, TS), jnp.bfloat16),      # copy-back rotation
                 pltpu.VMEM((2, TS, W), jnp.uint8),       # RMW/cb-read bounce
                 pltpu.VMEM((2 * TS * (CHUNK // T), W), jnp.uint8),  # placed
-                pltpu.VMEM((2, 128), jnp.int32),         # subtile totals
-                pltpu.SMEM((2, 128), jnp.int32),         # totals landing
+                pltpu.VMEM((128, 2), jnp.int32),         # subtile totals
+                pltpu.SMEM((128, 2), jnp.int32),         # totals landing
                 pltpu.SemaphoreType.DMA((2,)),           # chunk/cb reads
                 pltpu.SemaphoreType.DMA,                 # prefills + finals
                 pltpu.SemaphoreType.DMA((NB,)),          # left flush ring
@@ -647,7 +718,7 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
         out_shape=[
             jax.ShapeDtypeStruct((n_pad, W), jnp.uint8),
             jax.ShapeDtypeStruct((n_pad, W), jnp.uint8),
-            jax.ShapeDtypeStruct((4, lanes), jnp.float32),
+            jax.ShapeDtypeStruct(hist_shape, jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
         input_output_aliases={1: 0},
@@ -656,11 +727,14 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     return rows_new, hist, nl
 
 
-def fold_hist(hist4: jax.Array, num_features: int, num_bins: int) -> jax.Array:
-    """[4, f_pad*B] hi/lo rows -> [F, 2, B] f32 (same fold as
-    histogram_pallas_rows)."""
+def fold_hist(hist_raw: jax.Array, num_features: int,
+              num_bins: int) -> jax.Array:
+    """Kernel histogram accumulator -> [F, 2, B] f32 (factored or classic
+    layout, matching partition_hist_pallas's choice)."""
+    if _use_factored(num_features, num_bins):
+        return _fold_factored(hist_raw, num_features, num_bins)
     f_pad = _padded_features(num_features, num_bins)
-    folded = hist4[0:2] + hist4[2:4]
+    folded = hist_raw[0:2] + hist_raw[2:4]
     return folded.reshape(2, f_pad, num_bins).transpose(1, 0, 2)[:num_features]
 
 

@@ -1075,7 +1075,7 @@ class SerialTreeLearner:
             group=group, offset=offset)
         # rows padded so the Pallas row tile divides N
         self.num_data = dataset.num_data
-        self.padded_rows = (-self.num_data) % 2048 if self.use_pallas else 0
+        self.padded_rows = (-self.num_data) % _PCHUNK if self.use_pallas else 0
         matrix = (dataset.binned if self.grouped or not dataset.is_bundled
                   else dataset.unbundled_matrix())
         self.packed_cols = 0

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.partition import CHUNK as _PCHUNK
 from ..core.split import FeatureInfo
 from ..core.tree_learner import (Comm, SerialTreeLearner, TreeArrays,
                                  build_tree_partitioned)
@@ -78,7 +79,7 @@ class _ParallelTreeLearner(SerialTreeLearner):
     def _repad(self, dataset) -> None:
         d = self.num_shards
         if self.mode != "feature":
-            row_mult = 2048 * d if self.use_pallas else d
+            row_mult = _PCHUNK * d if self.use_pallas else d
             self.padded_rows = (-self.num_data) % row_mult
         binned = self._pad_host_rows(self._host_bins)
         del self._host_bins

@@ -1,7 +1,12 @@
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
-from lightgbm_tpu.core.histogram import histogram_xla, histogram_pallas
+from lightgbm_tpu.core.histogram import (histogram_pallas,
+                                         histogram_pallas_rows,
+                                         histogram_xla, histogram_xla_masked,
+                                         pack_nibbles, rows_split_xla,
+                                         _use_factored)
 
 
 def make(n=1024, f=6, b=32, seed=0):
@@ -48,6 +53,54 @@ def test_histogram_pallas_exact_mode_tight_tolerance():
                                       128, row_tile=1024, interpret=True,
                                       exact=True))
     np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-5)
+
+
+def make_rows_store(n, f, b, seed=0, bpc=1, packed=False, W=128):
+    rng = np.random.RandomState(seed)
+    nbytes = (f + 1) // 2 if packed else f * bpc
+    voff = -(-nbytes // 64) * 64          # past the bin columns, 4-aligned
+    W = max(W, voff + 64)
+    rows = np.zeros((n, W), dtype=np.uint8)
+    if packed:
+        codes = rng.randint(0, min(b, 16), size=(n, f)).astype(np.uint8)
+        rows[:, :(f + 1) // 2] = pack_nibbles(codes)
+    elif bpc == 2:
+        codes = rng.randint(0, b, size=(n, f)).astype(np.uint16)
+        rows[:, 0:2 * f:2] = (codes & 255).astype(np.uint8)
+        rows[:, 1:2 * f:2] = (codes >> 8).astype(np.uint8)
+    else:
+        rows[:, :f] = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    rows[:, voff:voff + 4] = grad.view(np.uint8).reshape(n, 4)
+    rows[:, voff + 4:voff + 8] = hess.view(np.uint8).reshape(n, 4)
+    return rows, voff
+
+
+@pytest.mark.parametrize("b,bpc,packed,f", [
+    (32, 1, False, 6),        # factored 8x4
+    (64, 1, False, 28),       # factored 8x8 (the bench shape)
+    (256, 1, False, 11),      # factored 16x16 (max_bin=255)
+    (512, 2, False, 5),       # factored 16x32, two-byte codes
+    (32, 1, True, 7),         # factored over nibble-packed columns
+    (64, 1, False, 125),      # wide F: classic packed-tile fallback
+])
+def test_histogram_rows_interpret_matches_xla(b, bpc, packed, f):
+    """histogram_pallas_rows (factored hi/lo MXU path and the classic
+    fallback) vs the backend-agnostic reference, over a sub-window."""
+    n = 2048
+    rows, voff = make_rows_store(n, f, b, seed=b + f, bpc=bpc, packed=packed,
+                                 W=128 if bpc == 1 else 256)
+    start, count = 700, 900
+    got = np.asarray(histogram_pallas_rows(
+        jnp.asarray(rows), b, jnp.int32(start), jnp.int32(count),
+        num_features=f, voff=voff, bpc=bpc, packed=packed,
+        row_tile=1024, interpret=True))
+    bins, values = rows_split_xla(jnp.asarray(rows), f, voff, bpc, packed)
+    want = np.asarray(histogram_xla_masked(
+        bins, values, b, jnp.int32(start), jnp.int32(count)))
+    assert _use_factored(f, b) == (f + 4 <= 124)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 def test_histogram_masked_rows_contribute_nothing():

@@ -1,0 +1,82 @@
+"""Phase attribution for the fused split pass via dbg_skip knockouts.
+
+Calls partition_hist_pallas directly on a synthetic row store at a few window
+sizes with phases knocked out (outputs are wrong; timing only), aggregating
+device time from xplane.  The deltas between variants are the per-phase costs
+recorded in PERF.md.
+
+Usage: python tools/knockout_bench.py [n_rows]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tools.profile_tree import aggregate_xplane
+
+VARIANTS = [
+    ("full", ""),
+    ("no-hist", "hist"),
+    ("A+B only", "hist,phaseC,flush"),
+    ("A only", "hist,phaseB,phaseC,flush"),
+]
+
+
+def main():
+    from lightgbm_tpu.core.partition import CHUNK, partition_hist_pallas
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2 ** 21  # 2M rows
+    W = 128
+    B = 64
+    f = 28
+    rng = np.random.RandomState(0)
+    rows = rng.randint(0, 64, size=(n + CHUNK, W)).astype(np.uint8)
+    rows = jnp.asarray(rows)
+    # numerical split on feature 3, threshold 31, window = all n rows
+    scal = np.zeros((12 + B // 32,), np.int32)
+    scal[1] = n          # window_count
+    scal[2] = 3          # group col
+    scal[3] = 31         # threshold
+    scal[6] = 64         # num_bin_f
+    scal[9] = 1          # hist left side
+    scal = jnp.asarray(scal)
+
+    reps = 8
+    print("rows=%d  reps=%d" % (n, reps))
+    res = {}
+    for name, skip in VARIANTS:
+        def run():
+            r = rows
+            out = None
+            for _ in range(reps):
+                r, h, nl = partition_hist_pallas(
+                    r, scal, num_features=f, num_bins=B, voff=32,
+                    dbg_skip=skip)
+            return r, h, nl
+
+        r, h, nl = run()   # compile + warm
+        jax.block_until_ready((r, h, nl))
+        trace_dir = "/tmp/lgbm_tpu_knock/" + name.replace(" ", "_")
+        with jax.profiler.trace(trace_dir):
+            r, h, nl = run()
+            jax.block_until_ready((r, h, nl))
+            float(jax.device_get(nl[0, 0]))
+        rows_t = aggregate_xplane(trace_dir, top=10)
+        ms = max(rows_t, key=lambda x: x[1])[1]
+        per_row = ms / reps * 1e6 / n
+        res[name] = per_row
+        print("%-12s %9.3f ms total  %6.2f ns/row" % (name, ms, per_row))
+
+    if "no-hist" in res:
+        print("-> hist        %6.2f ns/row-of-window" % (res["full"] - res["no-hist"]))
+        print("-> C+flush     %6.2f ns/row" % (res["no-hist"] - res["A+B only"]))
+        print("-> B           %6.2f ns/row" % (res["A+B only"] - res["A only"]))
+        print("-> A           %6.2f ns/row" % res["A only"])
+
+
+if __name__ == "__main__":
+    main()

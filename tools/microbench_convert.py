@@ -1,0 +1,121 @@
+"""Microbenchmark: u8 -> i32/bf16 tile conversion costs on v5e.
+
+The fused split pass converts every streamed [CHUNK, W] u8 tile to i32 and
+bf16; round-5 knockouts show this chain at ~2.6 ns/row — the single largest
+phase-A cost.  This probes the pieces and possible cheaper forms.
+"""
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tools.profile_tree import aggregate_xplane
+
+ROWS = 2048
+REPS = 16
+GRID = 32
+
+
+def _bench(name, kernel, x):
+    fn = pl.pallas_call(
+        kernel,
+        grid=(GRID,),
+        in_specs=[pl.BlockSpec(x.shape, lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )
+    fn = jax.jit(fn)
+    r = fn(x)
+    r.block_until_ready()
+    trace_dir = "/tmp/lgbm_tpu_conv/" + name.replace(" ", "_")
+    with jax.profiler.trace(trace_dir):
+        r = fn(x)
+        r.block_until_ready()
+        float(jax.device_get(r[0, 0]))
+    rows = aggregate_xplane(trace_dir, top=40)
+    ms = max(rows, key=lambda x: x[1])[1]
+    per_row = ms * 1e6 / (GRID * REPS * ROWS)
+    print("%-26s %9.3f ms   %.3f ns/row-of-128B" % (name, ms, per_row))
+
+
+def conv_u8_i32(x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    acc = jnp.zeros((ROWS, 128), jnp.int32)
+    for r in range(REPS):
+        ti = x_ref[...].astype(jnp.int32)
+        acc = acc + ti + (i + r)           # consume, block CSE via (i+r)
+    o_ref[...] += jnp.sum(acc.reshape(8, ROWS // 8, 128), axis=1
+                          ).astype(jnp.float32)
+
+
+def conv_u8_i32_bf16(x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    acc = jnp.zeros((ROWS, 128), jnp.bfloat16)
+    for r in range(REPS):
+        tb = x_ref[...].astype(jnp.int32).astype(jnp.bfloat16)
+        acc = acc + tb * (1.0 + 0.001 * (i + r))
+    o_ref[...] += jnp.sum(acc.reshape(8, ROWS // 8, 128), axis=1
+                          ).astype(jnp.float32)
+
+
+def conv_u8_i32_f32(x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    acc = jnp.zeros((ROWS, 128), jnp.float32)
+    for r in range(REPS):
+        tb = x_ref[...].astype(jnp.int32).astype(jnp.float32)
+        acc = acc + tb * (1.0 + 0.001 * (i + r))
+    o_ref[...] += jnp.sum(acc.reshape(8, ROWS // 8, 128), axis=1)
+
+
+def conv_bitcast_unpack(x_ref, o_ref):
+    """u8 [ROWS,128] -> i32 view [ROWS//4,128] -> 4 shifted/masked i32 tiles
+    (byte j of word = row 4k+j).  Avoids the u8 unpack relayout; rows come
+    out 4-row-grouped (usable when the consumer reorders or is row-agnostic,
+    e.g. histogram contractions)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    w = pltpu.bitcast(x_ref[...], jnp.int32)     # [ROWS//4, 128]
+    acc = jnp.zeros((ROWS // 4, 128), jnp.int32)
+    for r in range(REPS):
+        b0 = w & 255
+        b1 = (w >> 8) & 255
+        b2 = (w >> 16) & 255
+        b3 = (w >> 24) & 255
+        acc = acc + b0 + b1 + b2 + b3 + (i + r)
+    o_ref[...] += jnp.sum(acc.reshape(8, ROWS // 32, 128), axis=1
+                          ).astype(jnp.float32)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, 255, size=(ROWS, 128)), jnp.uint8)
+    print("v5e u8-tile conversion microbenchmark ([%d, 128] tiles)" % ROWS)
+    _bench("u8->i32", conv_u8_i32, x)
+    _bench("u8->i32->bf16", conv_u8_i32_bf16, x)
+    _bench("u8->i32->f32", conv_u8_i32_f32, x)
+    _bench("bitcast+shift (4row)", conv_bitcast_unpack, x)
+
+
+if __name__ == "__main__":
+    main()

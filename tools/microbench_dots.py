@@ -1,0 +1,151 @@
+"""Microbenchmark: the fused split pass's matmul shapes on v5e.
+
+Phase-A attribution via knockouts is confounded by constant folding, so this
+times each dot shape in isolation: the transposed column extraction
+([2, W] @ [CHUNK, W]^T), the prefix dot ([2*nsub, T] @ [T, T]), the tiny
+totals dot, and the placement dot ([2TS, T] @ [T, W]).
+"""
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tools.profile_tree import aggregate_xplane
+
+CHUNK = 2048
+W = 128
+T = 128
+REPS = 16
+GRID = 32
+
+
+def _bench(name, kernel, shapes, denom):
+    args = [jnp.asarray(np.random.RandomState(i).normal(size=s),
+                        jnp.bfloat16) for i, s in enumerate(shapes)]
+    fn = pl.pallas_call(
+        kernel,
+        grid=(GRID,),
+        in_specs=[pl.BlockSpec(a.shape, lambda i: (0, 0)) for a in args],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )
+    fn = jax.jit(fn)
+    r = fn(*args)
+    r.block_until_ready()
+    trace_dir = "/tmp/lgbm_tpu_dots/" + "".join(ch for ch in name if ch.isalnum())
+    with jax.profiler.trace(trace_dir):
+        r = fn(*args)
+        r.block_until_ready()
+        float(jax.device_get(r[0, 0]))
+    rows = aggregate_xplane(trace_dir, top=40)
+    ms = max(rows, key=lambda x: x[1])[1]
+    per = ms * 1e6 / (GRID * REPS * denom)
+    print("%-34s %9.3f ms   %.3f ns/row" % (name, ms, per))
+
+
+def dot_extract_T(a_ref, b_ref, o_ref):
+    """[2, W] @ [CHUNK, W]^T -> [2, CHUNK] (current phase-A orientation)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    acc = jnp.zeros((2, CHUNK), jnp.float32)
+    for r in range(REPS):
+        wm = a_ref[...] + jnp.bfloat16(0.0)
+        out = jax.lax.dot_general(wm, b_ref[...] , (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        acc = acc + out * (1.0 + 0.001 * (i + r))
+    o_ref[...] += jnp.pad(jnp.sum(acc.reshape(2, CHUNK // 128, 128), axis=1),
+                          ((0, 6), (0, 0)))
+
+
+def dot_extract_row(a_ref, b_ref, o_ref):
+    """[CHUNK, W] @ [W, 2] -> [CHUNK, 2] (round-4 orientation)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    acc = jnp.zeros((CHUNK, 2), jnp.float32)
+    for r in range(REPS):
+        wm = a_ref[...] + jnp.bfloat16(0.0)
+        out = jax.lax.dot_general(b_ref[...], wm, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        acc = acc + out * (1.0 + 0.001 * (i + r))
+    o_ref[...] += jnp.pad(
+        jnp.sum(acc.reshape(8, CHUNK // 8, 2), axis=1), ((0, 0), (0, 126)))
+
+
+def dot_extract_T36(a_ref, b_ref, o_ref):
+    """[36, W] @ [CHUNK, W]^T (the hist pass's E_full extraction)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    acc = jnp.zeros((36, CHUNK), jnp.float32)
+    for r in range(REPS):
+        wm = a_ref[...] + jnp.bfloat16(0.0)
+        out = jax.lax.dot_general(wm, b_ref[...], (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        acc = acc + out * (1.0 + 0.001 * (i + r))
+    s = jnp.sum(acc.reshape(36, CHUNK // 128, 128), axis=1)
+    o_ref[...] += jnp.pad(s[:8], ((0, 0), (0, 0)))
+
+
+def dot_prefix(a_ref, b_ref, o_ref):
+    """[32, T] @ [T, T] prefix dot."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    acc = jnp.zeros((32, T), jnp.float32)
+    for r in range(REPS):
+        wm = a_ref[...] + jnp.bfloat16(0.0)
+        out = jax.lax.dot_general(wm, b_ref[...], (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        acc = acc + out * (1.0 + 0.001 * (i + r))
+    o_ref[...] += jnp.pad(jnp.sum(acc.reshape(8, 4, T), axis=1),
+                          ((0, 0), (0, 128 - T)))
+
+
+def dot_place(a_ref, b_ref, o_ref):
+    """[2TS, T] @ [T, W] placement dot (x nsub per chunk)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _z():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    acc = jnp.zeros((2 * T, W), jnp.float32)
+    for r in range(REPS):
+        wm = a_ref[...] + jnp.bfloat16(0.0)
+        out = jax.lax.dot_general(wm, b_ref[...], (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        acc = acc + out * (1.0 + 0.001 * (i + r))
+    o_ref[...] += jnp.sum(acc.reshape(8, 2 * T // 8, W), axis=1)
+
+
+def main():
+    print("v5e split-pass dot shapes (ns per data row)")
+    _bench("extract [2,W]@[CHUNK,W]T", dot_extract_T,
+           [(2, W), (CHUNK, W)], CHUNK)
+    _bench("extract [CHUNK,W]@[W,2]", dot_extract_row,
+           [(W, 2), (CHUNK, W)], CHUNK)
+    _bench("extract36 [36,W]@[CHUNK,W]T", dot_extract_T36,
+           [(36, W), (CHUNK, W)], CHUNK)
+    _bench("prefix [32,T]@[T,T]  (/chunk)", dot_prefix,
+           [(32, T), (T, T)], CHUNK)
+    _bench("place [2TS,T]@[T,W] (x16)", dot_place,
+           [(2 * T, T), (T, W)], T)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Microbenchmark: phase A of the fused split pass, stage by stage.
+
+Replicates the exact phase-A computation on a VMEM-resident [CHUNK, W] u8
+tile, adding one stage per variant; the deltas attribute cost without the
+constant-folding traps of in-kernel knockouts (a zeroed input folds every
+downstream op away).
+"""
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tools.profile_tree import aggregate_xplane
+
+CHUNK = 2048
+W = 128
+T = 128
+LANE = 128
+REPS = 16
+GRID = 32
+NSUB = CHUNK // T
+NPK = CHUNK // LANE
+
+
+def _consume(o_ref, arrs):
+    """Cheap LIVE consumption: add a tiny slice-sum of each array."""
+    for a in arrs:
+        af = a.astype(jnp.float32) if a.dtype != jnp.float32 else a
+        r = min(8, af.shape[0])
+        o_ref[0:r, 0:1] += jnp.sum(af[0:r, :], axis=1, keepdims=True)
+
+
+def make_kernel(stage):
+    def kernel(x_ref, o_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _z():
+            o_ref[...] = jnp.zeros_like(o_ref)
+
+        iota_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+        for r in range(REPS):
+            gcol = 3 + ((i + r) & 3)          # defeat CSE across reps
+            live = []
+            ti = x_ref[...].astype(jnp.int32)
+            ti_bf = ti.astype(jnp.bfloat16)
+            live += [ti_bf[:8]]
+            if stage >= 1:                     # extraction dot + packed col
+                colsel = (iota_w == gcol).astype(jnp.bfloat16)
+                colsel2 = jnp.zeros((1, W), jnp.bfloat16)
+                wmat = jnp.concatenate([colsel, colsel2], axis=0)
+                extT = jax.lax.dot_general(
+                    wmat, ti_bf, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                extTi = extT.astype(jnp.int32)
+                col_p = extTi[0:1, :].reshape(NPK, LANE)
+                live += [col_p]
+            if stage >= 2:                     # routing + window masks
+                thr = 31 + (r & 1)
+                gl = (col_p <= thr).astype(jnp.int32)
+                gl = jnp.where(col_p == 63, 1, gl)   # missing-ish branch
+                pos = (jax.lax.broadcasted_iota(jnp.int32, (NPK, 1), 0)
+                       * LANE
+                       + jax.lax.broadcasted_iota(jnp.int32, (1, LANE), 1))
+                inw = ((pos >= 100).astype(jnp.int32)
+                       * (pos < CHUNK - 3).astype(jnp.int32))
+                selL = gl * inw
+                selR = (1 - gl) * inw
+                live += [selL, selR]
+            if stage >= 3:                     # S concat + prefix + totals
+                ltri = (jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+                        <= jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+                        ).astype(jnp.bfloat16)
+                S = jnp.concatenate([selL, selR], axis=0).astype(jnp.bfloat16)
+                pfxU = jax.lax.dot_general(
+                    S, ltri, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                tot_col = pfxU[:, T - 1:T]
+                iiB = jax.lax.broadcasted_iota(jnp.int32, (2 * NSUB, 1), 0)
+                jjB = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * NSUB), 1)
+                triB = ((iiB >= jjB).astype(jnp.int32)
+                        * ((iiB < NSUB) == (jjB < NSUB)).astype(jnp.int32)
+                        ).astype(jnp.bfloat16)
+                incl_col = jax.lax.dot_general(
+                    triB, tot_col.astype(jnp.bfloat16),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                live += [pfxU[:8], incl_col]
+            _consume(o_ref, live)
+
+    return kernel
+
+
+def _bench(name, stage, x):
+    fn = jax.jit(pl.pallas_call(
+        make_kernel(stage),
+        grid=(GRID,),
+        in_specs=[pl.BlockSpec(x.shape, lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    ))
+    r = fn(x)
+    r.block_until_ready()
+    trace_dir = "/tmp/lgbm_tpu_pha/" + "".join(c for c in name if c.isalnum())
+    with jax.profiler.trace(trace_dir):
+        r = fn(x)
+        r.block_until_ready()
+        float(jax.device_get(r[0, 0]))
+    rows = aggregate_xplane(trace_dir, top=40)
+    ms = max(rows, key=lambda q: q[1])[1]
+    print("%-30s %9.3f ms   %.3f ns/row"
+          % (name, ms, ms * 1e6 / (GRID * REPS * CHUNK)))
+
+
+def main():
+    x = jnp.asarray(np.random.RandomState(0).randint(0, 64, (CHUNK, W)),
+                    jnp.uint8)
+    print("phase-A stage attribution ([%d, %d] u8 chunk)" % (CHUNK, W))
+    _bench("0: converts", 0, x)
+    _bench("1: + extract/reshape", 1, x)
+    _bench("2: + route/sel", 2, x)
+    _bench("3: + S/prefix/totals", 3, x)
+
+
+if __name__ == "__main__":
+    main()
