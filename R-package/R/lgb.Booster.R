@@ -54,6 +54,11 @@ lgb.current.iter <- function(booster) {
 predict.lgb.Booster <- function(object, data, rawscore = FALSE,
                                 predleaf = FALSE, predcontrib = FALSE,
                                 num_iteration = -1L, ...) {
+  # reject conflicting modes BEFORE dispatch so the in-process and CLI
+  # layers cannot disagree on precedence (single-mode predict contract)
+  if (sum(c(rawscore, predleaf, predcontrib)) > 1L) {
+    stop("predict: only one of rawscore / predleaf / predcontrib may be TRUE")
+  }
   if (!.lgbmtpu_glue_loaded() || is.null(object$handle)) {
     return(.lgbmtpu_cli_predict(object, data, rawscore = rawscore,
                                 predleaf = predleaf,

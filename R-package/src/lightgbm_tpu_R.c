@@ -175,6 +175,11 @@ SEXP R_lgbmtpu_booster_eval(SEXP handle, SEXP data_idx) {
   check(LGBM_BoosterGetEval(R_ExternalPtrAddr(handle),
                             Rf_asInteger(data_idx), &out_len, REAL(out)),
         "BoosterGetEval");
+  /* Rf_allocVector does not zero-initialize: a short write would leave
+     uninitialized tail values, so a count mismatch is an error, not a
+     truncation. */
+  if (out_len != n)
+    error("BoosterGetEval wrote %d results, expected %d", out_len, n);
   UNPROTECT(1);
   return out;
 }

@@ -1,0 +1,13 @@
+/* Stub of R.h for no-R-installation compile gating: see Rinternals.h. */
+#ifndef LGBM_TPU_R_STUB_R_H
+#define LGBM_TPU_R_STUB_R_H
+
+#include <stddef.h>
+
+void Rf_error(const char *, ...);
+#define error Rf_error
+char *R_alloc(size_t, int);
+void R_Free_stub(void *);
+#define Free(p) R_Free_stub(p)
+
+#endif

@@ -30,7 +30,7 @@ from ..config import Config
 from ..core.tree import Tree
 from ..core.tree_learner import (SerialTreeLearner, TreeArrays,
                                  build_tree_partitioned, route_binned,
-                                 tree_from_arrays)
+                                 tree_from_arrays, tree_output_binned)
 from ..parallel import create_tree_learner
 from ..io.dataset import BinnedDataset
 from ..metric.metric import Metric, create_metrics
@@ -42,7 +42,7 @@ K_EPSILON = 1e-15
 MODEL_VERSION = "v3"
 
 
-def _hoisted_jit(fused, example_score):
+def _hoisted_jit(fused, *example_args):
     """jit with every closed-over array hoisted to an explicit argument.
 
     Closure-captured arrays are inlined as dense literals in the lowered
@@ -51,25 +51,79 @@ def _hoisted_jit(fused, example_score):
     rejects the program with HTTP 413.  ``jax.make_jaxpr`` exposes exactly
     those captured arrays as ``.consts`` (``jax.closure_convert`` does NOT
     hoist concrete arrays — only tracer consts), so the program is re-entered
-    through ``eval_jaxpr`` with the consts as real parameters: bins,
-    objective label/weight vectors and the carried aux all in one sweep.
+    through ``eval_jaxpr`` with the consts as real parameters: bins, valid
+    bins, objective label/weight vectors and the carried aux in one sweep.
     """
-    spec = jax.ShapeDtypeStruct(example_score.shape, example_score.dtype)
-    closed, out_shape = jax.make_jaxpr(fused, return_shape=True)(spec)
+    specs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a)),
+        example_args)
+    closed, out_shape = jax.make_jaxpr(fused, return_shape=True)(*specs)
     out_tree = jax.tree_util.tree_structure(out_shape)
     consts = closed.consts
 
-    def converted(consts_, score):
-        out = jax.core.eval_jaxpr(closed.jaxpr, consts_, score)
+    def converted(consts_, *args):
+        flat, _ = jax.tree_util.tree_flatten(args)
+        out = jax.core.eval_jaxpr(closed.jaxpr, consts_, *flat)
         return jax.tree_util.tree_unflatten(out_tree, out)
 
     jitted = jax.jit(converted)
 
-    def call(score):
-        return jitted(consts, score)
+    def call(*args):
+        return jitted(consts, *args)
 
-    call.lower = lambda score: jitted.lower(consts, score)
+    call.lower = lambda *args: jitted.lower(consts, *args)
     return call
+
+
+def _bag_uniforms(row_ids, seed: int, it_window):
+    """Deterministic per-row uniforms in [0, 1) for bagging, keyed by
+    (original row id, bagging window).  A stateless integer hash (xxhash-
+    style avalanche) instead of a sequential RNG stream so the SAME mask is
+    reproducible from any execution order — per-iteration host path, fused
+    lax.scan, and the carried row store (where rows are permuted and only
+    their original ids are at hand) all agree bit-exactly.
+
+    Differs from the reference's exact-count sampling-without-replacement
+    (gbdt.cpp:160-276): each row is an independent Bernoulli(p) draw, so
+    ``bag_data_cnt`` is the realized count.  Quality-equivalent; pinned by
+    tests/test_boosting.py bagging windows."""
+    x = row_ids.astype(jnp.uint32) * jnp.uint32(2654435761)
+    x = x ^ (jnp.uint32(seed & 0xFFFFFFFF)
+             + it_window.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(2246822519)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(3266489917)
+    x = x ^ (x >> 16)
+    return x.astype(jnp.float32) * jnp.float32(1.0 / 4294967296.0)
+
+
+def _bag_mask_for(row_ids, seed: int, it, freq: int, frac: float):
+    """(mask f32 0/1, realized count i32) for iteration ``it`` — the ONE
+    implementation both the fused scan and the host per-iteration path use;
+    bit-exact agreement between them is asserted by
+    tests/test_fused_valid_bagging.py."""
+    itw = it - jax.lax.rem(it, jnp.int32(freq))
+    u = _bag_uniforms(row_ids, seed, itw)
+    mask = (u < jnp.float32(frac)).astype(jnp.float32)
+    cnt = jnp.maximum(jnp.sum(mask, dtype=jnp.float32), 1.0).astype(jnp.int32)
+    return mask, cnt
+
+
+def _add_valid_outputs(vscores, kk, arr, feat, vbins, num_leaves,
+                       has_categorical):
+    """Valid-score update for one scaled tree inside the fused scan: the
+    path-matrix router for numerical trees, per-level routing otherwise."""
+    depth = jnp.max(arr.leaf_depth)
+    if has_categorical:
+        return tuple(
+            vsc.at[kk].add(arr.leaf_value[route_binned(
+                vb, arr, feat, num_leaves=num_leaves, depth_bound=depth)])
+            for vsc, vb in zip(vscores, vbins))
+    return tuple(
+        vsc.at[kk].add(tree_output_binned(
+            vb, arr, feat, num_leaves=num_leaves, depth_bound=depth))
+        for vsc, vb in zip(vscores, vbins))
 
 
 class _LazyTreeSlice:
@@ -289,6 +343,8 @@ class GBDT:
                     self.objective.class_need_train(k)
                     for k in range(self.num_tree_per_iteration)]
         self.train_metrics = []
+        # plain bagging uses the stateless _bag_uniforms hash; this
+        # sequential stream remains for GOSS's sampling (goss.py)
         self._bag_rng = np.random.RandomState(int(self.config.bagging_seed))
         self._feat_rng = np.random.RandomState(
             int(self.config.feature_fraction_seed))
@@ -433,12 +489,14 @@ class GBDT:
         if (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
                 and it % cfg.bagging_freq == 0):
             n = self.num_data
-            cnt = max(1, int(n * cfg.bagging_fraction))
-            idx = self._bag_rng.choice(n, size=cnt, replace=False)
-            mask = np.zeros(n, dtype=np.float32)
-            mask[idx] = 1.0
-            self.bag_mask = self.learner.pad_rows(jnp.asarray(mask))
-            self.bag_data_cnt = cnt
+            # same stateless hash as the fused path, so fused and
+            # per-iteration training produce identical masks
+            mask, cnt = _bag_mask_for(
+                jnp.arange(n, dtype=jnp.int32), int(cfg.bagging_seed),
+                jnp.int32(it), int(cfg.bagging_freq),
+                float(cfg.bagging_fraction))
+            self.bag_mask = self.learner.pad_rows(mask)
+            self.bag_data_cnt = int(cnt)
         elif self.bag_mask is None:
             self.bag_data_cnt = self.num_data
 
@@ -563,11 +621,14 @@ class GBDT:
     #
     # On a remote/tunneled accelerator every jitted dispatch costs a host
     # round-trip (~100ms on axon); per-iteration training makes ~10 of them.
-    # When the iteration has no host-side decisions (no bagging, no feature
-    # sampling, no leaf renewal, device-traceable objective, serial learner,
-    # no validation sets) the whole k-iteration boosting loop runs as ONE
-    # compiled lax.scan: gradients -> tree build -> score update per step,
-    # trees emitted as stacked TreeArrays.
+    # When the iteration has no host-side decisions (no feature sampling, no
+    # leaf renewal, device-traceable objective, serial learner) the whole
+    # k-iteration boosting loop runs as ONE compiled lax.scan: gradients ->
+    # tree build -> score update per step, trees emitted as stacked
+    # TreeArrays.  Validation sets ride the scan as extra score carries
+    # (each tree routes the valid bins on device; metrics are computed on
+    # the host at chunk ends, which train() aligns to metric_freq), and
+    # bagging is an in-scan deterministic hash mask (_bag_uniforms).
 
     fuse_iters = True  # subclasses with per-iteration host logic opt out
 
@@ -577,12 +638,12 @@ class GBDT:
                 and not self.objective.is_renew_tree_output
                 and self.objective.deterministic_gradients):
             return False
-        if self.valid_sets or not self.train_data.num_features:
+        if not self.train_data.num_features:
             return False
         if not all(self.class_need_train):
             return False
         cfg = self.config
-        if float(cfg.bagging_fraction) < 1.0 or float(cfg.feature_fraction) < 1.0:
+        if float(cfg.feature_fraction) < 1.0:
             return False
         if getattr(self.learner, "comm", None) is not None:
             return False  # parallel learners keep the per-iteration path
@@ -593,6 +654,13 @@ class GBDT:
         return True
 
     _fuse_failed = False
+
+    def _fused_bag(self):
+        """(fraction, freq) when bagging is active (fused in-scan mask)."""
+        cfg = self.config
+        if cfg.bagging_freq > 0 and float(cfg.bagging_fraction) < 1.0:
+            return float(cfg.bagging_fraction), int(cfg.bagging_freq)
+        return None
 
     def _can_carry_rows(self) -> bool:
         """Carried-row-store training: per-row boosting state (aux, score)
@@ -636,8 +704,14 @@ class GBDT:
                 rows[:, off:off + 4], jnp.int32).reshape(rows.shape[0])
             return jax.lax.bitcast_convert_type(w, jnp.float32)
 
+        bag = self._fused_bag()
+        bag_seed = int(self.config.bagging_seed)
+        vbins = [vs["bins"] for vs in self.valid_sets]
+        L = learner.num_leaves
+
         def one_iter_of(bins):
-            def one_iter(rows, _):
+            def one_iter(carry, it):
+                rows, vscores = carry
                 score = f32col(rows, soff)
                 auxv = f32col(rows, aoff)
                 order = jax.lax.bitcast_convert_type(
@@ -647,16 +721,33 @@ class GBDT:
                 g, h = objective.pointwise_gradients(score, auxv)
                 g = g * validf
                 h = h * validf
+                if bag is not None:
+                    # the store is PERMUTED, so the mask must be keyed by
+                    # each row's ORIGINAL id (the order bytes) — exactly
+                    # what the stateless hash provides
+                    frac, freq = bag
+                    mask, _ = _bag_mask_for(order, bag_seed, it, freq, frac)
+                    mask = mask * validf
+                    nd_it = jnp.maximum(
+                        jnp.sum(mask, dtype=jnp.float32), 1.0
+                    ).astype(jnp.int32)
+                    g = g * mask
+                    h = h * mask
+                else:
+                    nd_it = nd
                 arr, rows = build_tree_partitioned(
-                    bins, g[:ntot], h[:ntot], nd, fm, feat,
+                    bins, g[:ntot], h[:ntot], nd_it, fm, feat,
                     rows_carry=rows, score_rate=jnp.float32(rate), **kwargs)
                 arr = arr._replace(
                     leaf_value=arr.leaf_value * rate,
                     internal_value=arr.internal_value * rate)
-                return rows, (arr,)
+                vscores = _add_valid_outputs(
+                    vscores, 0, arr, feat, vbins, L,
+                    learner.has_categorical)
+                return (rows, vscores), (arr,)
             return one_iter
 
-        def fused(score):
+        def fused(score, vscores, it0):
             bins, aux_arg = learner.bins, aux
             # construct the initial store from the ORIGINAL row order; the
             # num_leaves=1 build is a no-op tree whose only effect is the
@@ -668,17 +759,20 @@ class GBDT:
                 bins, zero, zero, nd, fm, feat,
                 extra=(aux_arg, score[0, :ntot]),
                 score_rate=jnp.float32(rate), **init_kwargs)
-            rows_fin, stacked = jax.lax.scan(one_iter_of(bins), rows0, None,
-                                             length=k)
+            (rows_fin, vs_out), stacked = jax.lax.scan(
+                one_iter_of(bins), (rows0, tuple(vscores)),
+                it0 + jnp.arange(k, dtype=jnp.int32))
             sc = f32col(rows_fin, soff)
             order = jax.lax.bitcast_convert_type(
                 rows_fin[:, voff + 8:voff + 12], jnp.int32
             ).reshape(rows_fin.shape[0])
             score_out = jnp.zeros((ntot,), jnp.float32).at[order].set(
                 sc, mode="drop")
-            return score_out[None], stacked
+            return score_out[None], vs_out, stacked
 
-        return _hoisted_jit(fused, self.train_score)
+        return _hoisted_jit(fused, self.train_score,
+                            tuple(vs["score"] for vs in self.valid_sets),
+                            jnp.int32(0))
 
     def _make_fused_train(self, k: int):
         if self._can_carry_rows():
@@ -703,31 +797,53 @@ class GBDT:
                       packed_cols=learner.packed_cols,
                       hist_pool_slots=learner.hist_pool_slots)
 
+        bag = self._fused_bag()
+        bag_seed = int(self.config.bagging_seed)
+        vbins = [vs["bins"] for vs in self.valid_sets]
+        L = learner.num_leaves
+
         def one_iter_of(bins):
-            def one_iter(score, _):
+            def one_iter(carry, it):
+                score, vscores = carry
                 live = score[:, :n]
                 g, h = objective.get_gradients(live[0] if K == 1 else live)
                 g = jnp.reshape(g, (K, n))
                 h = jnp.reshape(h, (K, n))
+                if bag is not None:
+                    frac, freq = bag
+                    mask, nd_it = _bag_mask_for(
+                        jnp.arange(n, dtype=jnp.int32), bag_seed, it, freq,
+                        frac)
+                    g = g * mask[None, :]
+                    h = h * mask[None, :]
+                else:
+                    nd_it = nd
                 outs = []
                 for kk in range(K):
                     gk = jnp.pad(g[kk], (0, pad))
                     hk = jnp.pad(h[kk], (0, pad))
-                    arr = build_tree_partitioned(bins, gk, hk, nd, fm,
+                    arr = build_tree_partitioned(bins, gk, hk, nd_it, fm,
                                                  feat, **kwargs)
                     arr = arr._replace(
                         leaf_value=arr.leaf_value * rate,
                         internal_value=arr.internal_value * rate)
                     score = score.at[kk].add(arr.leaf_value[arr.row_leaf])
+                    vscores = _add_valid_outputs(
+                        vscores, kk, arr, feat, vbins, L,
+                        learner.has_categorical)
                     outs.append(arr)
-                return score, tuple(outs)
+                return (score, vscores), tuple(outs)
             return one_iter
 
-        def fused(score):
-            return jax.lax.scan(one_iter_of(learner.bins), score, None,
-                                length=k)
+        def fused(score, vscores, it0):
+            (score, vs_out), stacked = jax.lax.scan(
+                one_iter_of(learner.bins), (score, tuple(vscores)),
+                it0 + jnp.arange(k, dtype=jnp.int32))
+            return score, vs_out, stacked
 
-        return _hoisted_jit(fused, self.train_score)
+        return _hoisted_jit(fused, self.train_score,
+                            tuple(vs["score"] for vs in self.valid_sets),
+                            jnp.int32(0))
 
     def train_chunk(self, num_iters: int) -> bool:
         """Run up to ``num_iters`` boosting iterations; fused into one XLA
@@ -742,7 +858,8 @@ class GBDT:
             return False
         # probe traceability BEFORE any state mutation so the fallback path
         # does not re-apply boost_from_average
-        key = (num_iters, self.shrinkage_rate, self.num_tree_per_iteration)
+        key = (num_iters, self.shrinkage_rate, self.num_tree_per_iteration,
+               len(self.valid_sets))
         fn = self._fused_cache.get(key)
         if fn is None:
             try:
@@ -759,8 +876,13 @@ class GBDT:
                        for kk in range(self.num_tree_per_iteration)]
         t0 = time.perf_counter()
         with FunctionTimer("GBDT::TrainChunk(dispatch)"):
-            new_score, stacked = fn(self.train_score)
+            new_score, new_vscores, stacked = fn(
+                self.train_score,
+                tuple(vs["score"] for vs in self.valid_sets),
+                jnp.int32(self.iter_))
         self.train_score = new_score
+        for vs, vsc in zip(self.valid_sets, new_vscores):
+            vs["score"] = vsc
         K = self.num_tree_per_iteration
         first_idx = len(self._models)
         first_iter = self.iter_

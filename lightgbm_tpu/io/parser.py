@@ -303,7 +303,11 @@ def sample_stream(path: str, sample_cnt: int, seed: int = 1,
             lines0 = _sniff_lines(path, 1)
             header = _has_header(lines0[0], sep) if lines0 else False
         # block-based line scan: 16 MB reads split in C, reservoir acceptance
-        # vectorized per block (a per-line Python loop ran at ~4 us/line)
+        # vectorized per block (a per-line Python loop ran at ~4 us/line).
+        # LIMITATION: blocks split on bare \n, so quoted fields containing
+        # embedded newlines would corrupt sampled rows AND the row count —
+        # matching the reference parser, which is also line-based and has no
+        # quote support (src/io/parser.hpp CSVParser::ParseOneLine)
         line_sample = []
         with open_file(path) as fh:
             if header:
