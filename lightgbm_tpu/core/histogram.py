@@ -340,11 +340,17 @@ def _factored_out_shape(num_features: int, num_bins: int):
 
 
 def _extract_T(ti_bf, *, num_features: int, voff: int, bpc: int,
-               packed: bool, exact: bool, inwT=None):
+               packed: bool, exact: bool, inwT=None, f_base=0):
     """Transposed extraction: bin codes + g/h from a [R, W] bf16 row-store
     tile in ONE [M, W] @ [R, W]^T dot (byte values are exact in bf16; the
     g/h f32s are rebuilt from two 16-bit halves so f32 accumulation is
     exact).  Returns (colT_fn, v4T) for _accum_factored_T.
+
+    ``f_base``: first feature of the extracted window (traced scalar ok) —
+    feature-parallel shards histogram only their own F/d block
+    (feature_parallel_tree_learner.cpp:33-52) while the row store keeps
+    every routable column.  Requires f_base to be byte-aligned for the
+    packed-nibble layout (callers shard in whole-byte multiples).
 
     Keeping every per-row intermediate LANE-major ([k, R]) matters as much
     as the dot itself: sliced [R, 1] intermediates are 128x vreg-padded."""
@@ -354,17 +360,17 @@ def _extract_T(ti_bf, *, num_features: int, voff: int, bpc: int,
     rows = []
     if packed:
         for f in range(0, num_features, 2):
-            rows.append((iota_w == f // 2))
+            rows.append((iota_w == (f_base + f) // 2))
         ncol_rows = len(rows)
     elif bpc == 2:
         for f in range(num_features):
-            rows.append((iota_w == 2 * f))
+            rows.append((iota_w == 2 * (f_base + f)))
         for f in range(num_features):
-            rows.append((iota_w == 2 * f + 1))
+            rows.append((iota_w == 2 * (f_base + f) + 1))
         ncol_rows = num_features
     else:
         for f in range(num_features):
-            rows.append((iota_w == f))
+            rows.append((iota_w == f_base + f))
         ncol_rows = num_features
     # g/h as two 16-bit halves each (i32 wrap restores the sign bit; the
     # OBVIOUS shifted-slice OR chain is miscompiled on v5e — see
@@ -397,8 +403,11 @@ def _extract_T(ti_bf, *, num_features: int, voff: int, bpc: int,
 
     if packed:
         def colT_fn(f):
+            # row k of E covers byte (f_base + 2k) // 2; nibble parity is
+            # GLOBAL ((f_base + f) % 2) — callers keep f_base even so the
+            # two halves of a byte stay in one shard
             byte = allTi[f // 2:f // 2 + 1, :]
-            return (byte >> (4 * (f % 2))) & 15
+            return (byte >> (4 * ((f_base + f) % 2))) & 15
     elif bpc == 2:
         def colT_fn(f):
             return (allTi[f:f + 1, :]
@@ -459,6 +468,10 @@ def _hist_kernel_rows(win_ref, rows_ref, out_ref, *, num_features: int,
         v4 = _hilo_split(vals, axis=1, exact=exact)      # [Nt, 4]
 
         def col(f):
+            # classic path keeps static column slices: the feature window
+            # (win_ref[2]) is only supported on the factored path, which
+            # every feature-sharded configuration satisfies (F/d + 4 <= 124
+            # after sharding, or the learner falls back to replicated scan)
             if packed:
                 return (w[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
             if bpc == 2:
@@ -474,7 +487,8 @@ def _hist_kernel_rows_fac(win_ref, rows_ref, out_ref, *, num_features: int,
                           voff: int, bpc: int, exact: bool = False):
     """Factored-MXU variant of _hist_kernel_rows: transposed extraction +
     hi/lo outer-product accumulation (see _accum_factored_T).  out_ref:
-    [G*128, p*nlo] f32 — fold with _fold_factored."""
+    [G*128, p*nlo] f32 — fold with _fold_factored.  win_ref[2] is the
+    feature-window base (feature-parallel shards)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -492,7 +506,7 @@ def _hist_kernel_rows_fac(win_ref, rows_ref, out_ref, *, num_features: int,
                 * (posT < start + count).astype(jnp.float32))
         colT_fn, v4T = _extract_T(ti_bf, num_features=num_features,
                                   voff=voff, bpc=bpc, packed=packed,
-                                  exact=exact, inwT=inwT)
+                                  exact=exact, inwT=inwT, f_base=win_ref[2])
         _accum_factored_T(colT_fn, v4T, out_ref,
                           num_features=num_features, num_bins=num_bins)
 
@@ -505,17 +519,21 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
                           bpc: int = 1, packed: bool = False,
                           row_tile: int = 2048,
                           interpret: bool = False,
-                          exact: bool = False) -> jax.Array:
+                          exact: bool = False,
+                          f_begin=0) -> jax.Array:
     """Histogram over rows [start, start+count) of a combined row store.
 
     rows: [R, W] u8 — bins bytes + f32 grad/hess at voff/voff+4 (see
-    _hist_kernel_rows).  Returns [F, 2, num_bins] f32."""
+    _hist_kernel_rows).  ``f_begin``/``num_features`` select the feature
+    window (feature-parallel shards histogram only their own block).
+    Returns [num_features, 2, num_bins] f32."""
     n, width = rows.shape
     assert n % row_tile == 0, "pad rows to a multiple of row_tile"
     assert _LANE % num_bins == 0 or num_bins % _LANE == 0, (
         "num_bins must divide or be a multiple of 128 (use _pad_bins_pow2); "
         "got %d" % num_bins)
-    win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32)])
+    win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32),
+                     jnp.asarray(f_begin, jnp.int32)])
 
     def _in_idx(i, win_ref):
         active = ((i * row_tile < win_ref[0] + win_ref[1])
@@ -588,16 +606,39 @@ def rows_split_xla(rows: jax.Array, num_features: int, voff: int,
 def histogram_rows(rows: jax.Array, num_bins: int, start, count, *,
                    num_features: int, voff: int, bpc: int = 1,
                    packed: bool = False,
-                   use_pallas: bool | None = None) -> jax.Array:
-    """Masked histogram over a combined row store; Pallas on TPU."""
+                   use_pallas: bool | None = None,
+                   f_begin=0) -> jax.Array:
+    """Masked histogram over a combined row store; Pallas on TPU.
+
+    ``f_begin``: feature-window base (may be traced) — feature-parallel
+    shards histogram only columns [f_begin, f_begin + num_features)."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas and rows.shape[0] % 2048 == 0:
         return histogram_pallas_rows(rows, num_bins, start, count,
                                      num_features=num_features, voff=voff,
                                      bpc=bpc, packed=packed,
-                                     exact=_exact_hist())
-    bins, values = rows_split_xla(rows, num_features, voff, bpc, packed)
+                                     exact=_exact_hist(), f_begin=f_begin)
+    if isinstance(f_begin, int) and f_begin == 0:
+        bins, values = rows_split_xla(rows, num_features, voff, bpc, packed)
+        return histogram_xla_masked(bins, values, num_bins, start, count)
+    # windowed XLA fallback: bins via a dynamic column slice, g/h from the
+    # fixed value columns
+    assert not packed, "feature windows are not used with nibble packing"
+    w = rows.astype(jnp.int32)
+    if bpc == 2:
+        sl = jax.lax.dynamic_slice_in_dim(
+            w, 2 * f_begin, 2 * num_features, axis=1)
+        bins = sl[:, 0::2] | (sl[:, 1::2] << 8)
+    else:
+        bins = jax.lax.dynamic_slice_in_dim(w, f_begin, num_features, axis=1)
+
+    def f32_at(off):
+        word = (w[:, off] | (w[:, off + 1] << 8) | (w[:, off + 2] << 16)
+                | (w[:, off + 3] << 24))
+        return jax.lax.bitcast_convert_type(word, jnp.float32)
+
+    values = jnp.stack([f32_at(voff), f32_at(voff + 4)], axis=0)
     return histogram_xla_masked(bins, values, num_bins, start, count)
 
 

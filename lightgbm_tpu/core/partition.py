@@ -105,7 +105,10 @@ def _route_tile(col, scal_ref, num_bins):
 
 
 def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
-                           packed, exact, dbg_skip=""):
+                           packed, exact, f_shard=False, dbg_skip=""):
+    # f_shard: the histogrammed feature window starts at scal[12 + B//32]
+    # (feature-parallel shards build only their own F/d block while routing
+    # on the full row store); num_features is then the WINDOW's width
     del n_pad  # shapes come from the refs; kept for cache-key clarity
 
     def kernel(scal_ref, rows_in_ref, rows_ref, scratch_ref, hist_ref,
@@ -485,9 +488,12 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                             jnp.int32, (1, CHUNK), 1))
                         inwT = ((posT >= head).astype(jnp.float32)
                                 * (posT < head + cnt).astype(jnp.float32))
+                        fb = (scal_ref[12 + num_bins // 32] if f_shard
+                              else 0)
                         colT_fn, v4T = _extract_T(
                             ti_bf_h, num_features=num_features, voff=voff,
-                            bpc=bpc, packed=packed, exact=exact, inwT=inwT)
+                            bpc=bpc, packed=packed, exact=exact, inwT=inwT,
+                            f_base=fb)
                         _accum_factored_T(colT_fn, v4T, hist_ref,
                                           num_features=num_features,
                                           num_bins=num_bins)
@@ -663,9 +669,14 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
       caller must keep every window end <= N_pad - CHUNK (the streaming loop
       reads and the copy-back RMW writes up to a CHUNK past the window end);
       the tree builder guarantees it by always padding a full spare CHUNK.
-    scal: i32 [12 + num_bins//32]: (window_begin, window_count, group_col,
-      threshold_bin, default_left, missing_type, num_bin_f, default_bin,
-      is_cat, hist_left_side, use_unfold, efb_offset, *cat_bitset_words).
+    scal: i32 [12 + num_bins//32] (+1 optional): (window_begin,
+      window_count, group_col, threshold_bin, default_left, missing_type,
+      num_bin_f, default_bin, is_cat, hist_left_side, use_unfold,
+      efb_offset, *cat_bitset_words[, hist_feature_begin]).  The optional
+      trailing element selects a feature WINDOW for the histogram
+      ([f_begin, f_begin + num_features)) — feature-parallel shards build
+      only their own block (feature_parallel_tree_learner.cpp:33-52);
+      routing always uses the full store.  Requires the factored path.
 
     Returns (rows_new [N_pad, W] u8 — the window stably partitioned in place,
     hist_raw f32 — smaller child's histogram in the kernel's accumulator
@@ -677,13 +688,17 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     assert num_bins >= 32 and num_bins % 32 == 0, \
         "num_bins must be the >=32 kernel-block width (_pad_bins_pow2); " \
         "nibble-packed 16-bin data still scans at 32 lanes"
+    f_shard = scal.shape[0] == 13 + num_bins // 32
     if _use_factored(num_features, num_bins):
         hist_shape = _factored_out_shape(num_features, num_bins)
     else:
+        assert not f_shard, \
+            "the histogram feature window needs the factored path"
         hist_shape = (4, _padded_features(num_features, num_bins) * num_bins)
     kernel = _make_partition_kernel(
         n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
-        voff=voff, bpc=bpc, packed=packed, exact=exact, dbg_skip=dbg_skip)
+        voff=voff, bpc=bpc, packed=packed, exact=exact, f_shard=f_shard,
+        dbg_skip=dbg_skip)
     rows_new, _scratch, hist, nl = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

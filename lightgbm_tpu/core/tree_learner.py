@@ -33,7 +33,7 @@ import numpy as np
 
 from .histogram import (build_histogram, histogram_rows, pack_nibbles,
                         partition_buckets, _exact_hist, _pad_bins,
-                        _pad_bins_pow2)
+                        _pad_bins_pow2, _use_factored)
 from .partition import (CHUNK as _PCHUNK, fold_hist, partition_hist_pallas)
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, per_feature_best_combined,
@@ -57,13 +57,15 @@ class Comm(NamedTuple):
       allreduce-argmax of the per-shard bests — the exact comm structure of
       ``DataParallelTreeLearner`` (data_parallel_tree_learner.cpp:149-240).
     - ``psum``: rows sharded; full-histogram allreduce per split.
-    - ``feature``: rows replicated, scan sharded over features
-      (feature_parallel_tree_learner.cpp:33-71); only the tiny best-split
-      allreduce crosses chips.  NOTE: unlike the reference (whose machines
-      hold vertical column shards), the partitioned row store must keep
-      every routable column on every chip, so histogram CONSTRUCTION is
-      replicated and only the scan shards — this mode is API parity, not
-      the scaling path (use ``rs``).
+    - ``feature``: rows replicated; each shard BUILDS histograms only for
+      its own F/d features (feature_parallel_tree_learner.cpp:33-52 — the
+      dominant cost) and scans them; only the tiny best-split allreduce
+      crosses chips.  The row store still keeps every routable column on
+      every chip (rows are replicated, partitioning is identical
+      everywhere), unlike the reference's vertical column shards.  Wide-F
+      configurations where the TPU kernel's factored histogram cannot take
+      a dynamic feature window fall back to a replicated build with a
+      sharded scan.
     - ``voting``: rows sharded; per-shard top-k feature election + global
       vote, then psum of only the elected features' histograms
       (voting_parallel_tree_learner.cpp:170-366).
@@ -336,10 +338,13 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             rows0 = jnp.concatenate([rows0, pad_block], axis=0)
 
     def hist_rows(rows_mat, start, count):
+        # hist_fc/hist_f0 are set below once the comm mode is known:
+        # feature-parallel shards histogram only their own F/d block
+        # (feature_parallel_tree_learner.cpp:33-52)
         return histogram_rows(rows_mat, num_bins, start, count,
-                              num_features=f_cols, voff=voff, bpc=bpc,
+                              num_features=hist_fc, voff=voff, bpc=bpc,
                               packed=bool(packed_cols),
-                              use_pallas=use_pallas)
+                              use_pallas=use_pallas, f_begin=hist_f0)
 
     def col_from_rows(wi, gcol):
         """Dynamic bin-column extract from [R, W] i32 row-store bytes."""
@@ -408,6 +413,14 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             min_sum_hessian_in_leaf=(params.min_sum_hessian_in_leaf
                                      / num_shards))
 
+    hist_fc, hist_f0 = f_cols, 0
+    if feat_mode and (not use_pallas or _use_factored(f // num_shards,
+                                                      num_bins)):
+        # shard histogram CONSTRUCTION, not just the scan; the TPU kernel
+        # needs the factored path for a dynamic feature window, so wide-F
+        # configurations keep the replicated build (scan still sharded)
+        hist_fc, hist_f0 = chunk_f, off_f
+
     def reduce_hist(h):
         if not axis_name or feat_mode or vote_mode:
             # feature: rows replicated, local histogram IS global;
@@ -423,7 +436,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         candidates (the reference's splits_per_leaf_ cache,
         cost_effective_gradient_boosting.hpp:35)."""
         if rs or feat_mode:
-            hc = h if rs else jax.lax.dynamic_slice_in_dim(
+            sharded = rs or hist_fc != f_cols
+            hc = h if sharded else jax.lax.dynamic_slice_in_dim(
                 h, off_f, chunk_f, axis=0)
             fb = per_feature_best_combined(
                 hc, feat_c, mask_c, sg, sh, cnt, params,
@@ -735,11 +749,15 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 bw = jnp.concatenate(
                     [bw, jnp.zeros((nw - bw.shape[0],), jnp.int32)])
             scal = jnp.concatenate([head, bw[:nw]])
+            if hist_fc != f_cols:
+                scal = jnp.concatenate(
+                    [scal, jnp.reshape(jnp.asarray(hist_f0, jnp.int32),
+                                       (1,))])
             rows_new, hist4, nl_arr = partition_hist_pallas(
-                st.rows, scal, num_features=f_cols, num_bins=num_bins,
+                st.rows, scal, num_features=hist_fc, num_bins=num_bins,
                 voff=voff, bpc=bpc, packed=bool(packed_cols),
                 exact=_exact_hist())
-            hist_small = fold_hist(hist4, f_cols, num_bins)
+            hist_small = fold_hist(hist4, hist_fc, num_bins)
             nl = nl_arr[0, 0]
             used_l = used_r = jnp.zeros((f,), f32)
         else:

@@ -6,11 +6,11 @@ Counterparts of the reference learners created by ``CreateTreeLearner``
 - ``DataParallelTreeLearner`` — rows sharded across chips; per-split global
   histograms by ``psum_scatter`` over the feature axis + allreduce-argmax of
   per-shard best splits (data_parallel_tree_learner.cpp:149-240).
-- ``FeatureParallelTreeLearner`` — data replicated, best-split scan sharded
-  over features; only the best-split argmax crosses chips
-  (feature_parallel_tree_learner.cpp:33-71).  Histogram construction is
-  replicated (the partitioned row store keeps every routable column on every
-  chip) — API parity, not the scaling path.
+- ``FeatureParallelTreeLearner`` — data replicated; histogram CONSTRUCTION
+  and best-split scan sharded over features (each shard builds only its own
+  F/d block, feature_parallel_tree_learner.cpp:33-52); only the best-split
+  argmax crosses chips.  The row store keeps every routable column on every
+  chip (rows are replicated), unlike the reference's vertical column shards.
 - ``VotingParallelTreeLearner`` — rows sharded; top-k feature election keeps
   per-split comm at O(2*top_k*bins) (voting_parallel_tree_learner.cpp:170-366).
 
@@ -236,10 +236,11 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
 
 
 class FeatureParallelTreeLearner(_ParallelTreeLearner):
-    """tree_learner=feature: replicated data on every shard, scan sharded
-    over features, one best-split allreduce per split
-    (feature_parallel_tree_learner.cpp:33-71).  Runs the partitioned base
-    builder like every other learner."""
+    """tree_learner=feature: replicated data on every shard, histogram
+    CONSTRUCTION and scan sharded over features (each shard builds only
+    its own F/d block, feature_parallel_tree_learner.cpp:33-52), one
+    best-split allreduce per split.  Runs the partitioned base builder
+    like every other learner."""
     mode = "feature"
     comm_mode = "feature"
 

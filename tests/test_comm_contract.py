@@ -115,3 +115,64 @@ def test_voting_elected_psum_payload():
     assert f"tensor<2x6x2x{B_KERNEL}xf32>" in ar_types, ar_types
     full = {t for t in ar_types if f"{F}x2x{B_KERNEL}" in t}
     assert not full, f"voting must NOT allreduce the full block: {full}"
+
+
+def test_data_parallel_measured_scaling_band():
+    """One MEASURED scaling number (VERDICT r4 #8): fixed TOTAL rows, d=1 vs
+    d=8 on the single-core virtual mesh.  With rows sharded correctly, total
+    row work is constant in d, so wall time must stay within a generous
+    band; if every shard accidentally processed ALL rows (gross
+    serialization — the failure this guards), d=8 would cost ~8x d=1.
+    (True per-device weak scaling needs real chips; the ICI-volume side is
+    pinned structurally above.)"""
+    import time
+
+    times = {}
+    for d in (1, 8):
+        rng = np.random.RandomState(0)
+        n = 64 * 1024
+        X = rng.normal(size=(n, F))
+        y = X[:, 0] + rng.normal(scale=0.1, size=n)
+        ds = BinnedDataset.from_matrix(X, label=y, max_bin=15)
+        cfg = Config(num_leaves=16, min_data_in_leaf=2)
+        learner = DataParallelTreeLearner(ds, cfg, mesh=default_mesh(d))
+        grad = learner.pad_rows(jnp.asarray(-(y - y.mean()),
+                                            dtype=jnp.float32))
+        hess = learner.pad_rows(jnp.ones((n,), dtype=jnp.float32))
+        arr = learner.train(grad, hess, n)
+        jax.block_until_ready(arr.leaf_value)         # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(3):
+            arr = learner.train(grad, hess, n)
+        jax.block_until_ready(arr.leaf_value)
+        times[d] = (time.perf_counter() - t0) / 3
+        assert int(arr.num_leaves) == 16
+    ratio = times[8] / times[1]
+    assert ratio < 3.0, (
+        f"d=8 took {ratio:.1f}x d=1 at fixed total rows "
+        f"({times}) — shards appear to duplicate row work")
+
+
+def test_feature_parallel_histogram_state_is_sharded():
+    """tree_learner=feature builds histograms only for the shard's own F/d
+    features (feature_parallel_tree_learner.cpp:33-52): the lowered
+    program's per-leaf histogram state is [L, F/d, 2, B], and the full
+    [L, F, 2, B] block never materializes."""
+    from lightgbm_tpu.parallel import FeatureParallelTreeLearner
+    rng = np.random.RandomState(0)
+    n, d, L = 1024, 8, 8
+    X = rng.normal(size=(n, F))
+    y = X[:, 0] + rng.normal(scale=0.1, size=n)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=15)
+    cfg = Config(num_leaves=L, min_data_in_leaf=2)
+    learner = FeatureParallelTreeLearner(ds, cfg, mesh=default_mesh(d))
+    grad = learner.pad_rows(jnp.asarray(-(y - y.mean()), dtype=jnp.float32))
+    hess = learner.pad_rows(jnp.ones((n,), dtype=jnp.float32))
+    fm = jnp.ones((learner.feat.num_bin.shape[0],), bool)
+    txt = learner._build_fn.lower(
+        learner.bins, grad, hess, jnp.int32(n), fm, learner.feat).as_text()
+    per_shard = F // d
+    assert re.search(rf"tensor<{L}x{per_shard}x2x{B_KERNEL}xf32>", txt), \
+        "per-shard histogram state [L, F/d, 2, B] not found"
+    assert not re.search(rf"tensor<{L}x{F}x2x{B_KERNEL}xf32>", txt), \
+        "feature mode must not build the full [L, F, 2, B] block"

@@ -56,6 +56,36 @@ def test_pooled_build_matches_unbounded():
     assert np.mean(got.row_leaf == want.row_leaf) >= 0.95
 
 
+def test_pooled_build_exact_mode_tight(monkeypatch):
+    """Under LIGHTGBM_TPU_EXACT_HIST=1 (f32 HIGHEST accumulation) the
+    rebuilt-parent float drift that justifies the loose default-mode band
+    disappears, so pooled-vs-unbounded must agree to <=2% — a windowing bug
+    (wrong rows streamed into the rebuild) would not survive this pin.
+
+    Different feature count than the loose test: _exact_hist() is read at
+    trace time, so a distinct shape guarantees a fresh trace."""
+    monkeypatch.setenv("LIGHTGBM_TPU_EXACT_HIST", "1")
+    ds, grad, hess, n = _problem(f=11, seed=7)
+    base = SerialTreeLearner(ds, Config(num_leaves=31, min_data_in_leaf=5))
+    want = jax.tree_util.tree_map(np.asarray, base.train(grad, hess, n))
+
+    ds2, grad, hess, n = _problem(f=11, seed=7)
+    pooled = SerialTreeLearner(ds2, Config(num_leaves=31, min_data_in_leaf=5,
+                                           histogram_pool_size=1))
+    pooled.hist_pool_slots = 4          # force heavy eviction
+    got = jax.tree_util.tree_map(np.asarray, pooled.train(grad, hess, n))
+
+    nl = int(want.num_leaves)
+    assert int(got.num_leaves) == nl
+    same_split = np.mean(got.split_feature[:nl - 1]
+                         == want.split_feature[:nl - 1])
+    assert same_split >= 0.98, f"only {same_split:.2%} splits agree"
+    assert np.mean(got.row_leaf == want.row_leaf) >= 0.98
+    np.testing.assert_allclose(np.sort(got.leaf_value[:nl]),
+                               np.sort(want.leaf_value[:nl]),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_pool_bounds_lowered_histogram_state():
     """The lowered program's histogram state is [K, ...], independent of
     num_leaves — the wide-feature memory bound the pool exists for."""
