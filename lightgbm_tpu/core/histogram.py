@@ -262,14 +262,20 @@ def _factored_geometry(num_features: int, num_bins: int):
 
 
 def _use_factored(num_features: int, num_bins: int) -> bool:
-    """The factored path computes a p x p all-pairs block per group (only the
-    diagonal is read), so its MXU cost scales with F^2/p — a win for the
-    narrow-F regime every binned GBDT dataset lives in after EFB, a loss for
-    very wide F.  The 124 bound is that crossover heuristic (and keeps the
-    transposed extraction dot around one 128-row M tile for single-byte
-    codes; bpc=2 builds 2F+4 selector rows, which is still a single valid
-    dot, just M-tiled)."""
-    return 32 <= num_bins and num_features + 4 <= 124
+    """Factored vs classic packed-tile histogram.
+
+    The classic one-hot costs ~2.5 VPU lane-ops per (row, feature, bin) —
+    ruinous for wide F x large B (F=968, B=256: ~620k lane-ops per row).
+    The factored path costs nhi + nlo compares + a 4*nhi-lane weighting per
+    (row, feature) plus a p x p all-pairs MXU block per feature group (only
+    the diagonal is read) — per-feature cost near-independent of B, so it
+    wins essentially everywhere the accumulator fits on-chip.  The bound
+    below caps the [G*128, p*nlo] f32 accumulator at 4 MiB of VMEM (it
+    lives alongside the partition kernel's ~3 MiB of streaming scratch)."""
+    if num_bins < 32:
+        return False
+    out = _factored_out_shape(num_features, num_bins)
+    return out[0] * out[1] * 4 <= (4 << 20)
 
 
 def _accum_factored_T(colT_fn, v4T, out_ref, *, num_features: int,
@@ -469,9 +475,10 @@ def _hist_kernel_rows(win_ref, rows_ref, out_ref, *, num_features: int,
 
         def col(f):
             # classic path keeps static column slices: the feature window
-            # (win_ref[2]) is only supported on the factored path, which
-            # every feature-sharded configuration satisfies (F/d + 4 <= 124
-            # after sharding, or the learner falls back to replicated scan)
+            # (win_ref[2]) is only supported on the factored path; the
+            # learner only shards histogram construction when the sharded
+            # width passes _use_factored (4 MiB accumulator bound), else it
+            # falls back to a replicated build with a sharded scan
             if packed:
                 return (w[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
             if bpc == 2:
@@ -532,6 +539,12 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
     assert _LANE % num_bins == 0 or num_bins % _LANE == 0, (
         "num_bins must divide or be a multiple of 128 (use _pad_bins_pow2); "
         "got %d" % num_bins)
+    # a feature window is only honored by the factored kernel; the classic
+    # fallback would silently histogram columns [0, F) mislabeled as the
+    # window, so reject the combination here rather than in a distant caller
+    assert _use_factored(num_features, num_bins) or (
+        isinstance(f_begin, int) and f_begin == 0), \
+        "f_begin needs the factored histogram path"
     win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32),
                      jnp.asarray(f_begin, jnp.int32)])
 
@@ -582,6 +595,14 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
     return folded.reshape(2, f_pad, num_bins).transpose(1, 0, 2)[:num_features]
 
 
+def _f32_col(w, off):
+    """Little-endian f32 from 4 byte columns of an i32-converted store
+    (XLA-side; the Mosaic slice-OR miscompile is kernel-specific)."""
+    word = (w[:, off] | (w[:, off + 1] << 8) | (w[:, off + 2] << 16)
+            | (w[:, off + 3] << 24))
+    return jax.lax.bitcast_convert_type(word, jnp.float32)
+
+
 def rows_split_xla(rows: jax.Array, num_features: int, voff: int,
                    bpc: int = 1, packed: bool = False):
     """Backend-agnostic unpack of a combined row store ->
@@ -593,13 +614,7 @@ def rows_split_xla(rows: jax.Array, num_features: int, voff: int,
         bins = w[:, 0:2 * num_features:2] | (w[:, 1:2 * num_features:2] << 8)
     else:
         bins = rows[:, :num_features]
-
-    def f32_at(off):
-        word = (w[:, off] | (w[:, off + 1] << 8) | (w[:, off + 2] << 16)
-                | (w[:, off + 3] << 24))
-        return jax.lax.bitcast_convert_type(word, jnp.float32)
-
-    values = jnp.stack([f32_at(voff), f32_at(voff + 4)], axis=0)
+    values = jnp.stack([_f32_col(w, voff), _f32_col(w, voff + 4)], axis=0)
     return bins, values
 
 
@@ -632,13 +647,7 @@ def histogram_rows(rows: jax.Array, num_bins: int, start, count, *,
         bins = sl[:, 0::2] | (sl[:, 1::2] << 8)
     else:
         bins = jax.lax.dynamic_slice_in_dim(w, f_begin, num_features, axis=1)
-
-    def f32_at(off):
-        word = (w[:, off] | (w[:, off + 1] << 8) | (w[:, off + 2] << 16)
-                | (w[:, off + 3] << 24))
-        return jax.lax.bitcast_convert_type(word, jnp.float32)
-
-    values = jnp.stack([f32_at(voff), f32_at(voff + 4)], axis=0)
+    values = jnp.stack([_f32_col(w, voff), _f32_col(w, voff + 4)], axis=0)
     return histogram_xla_masked(bins, values, num_bins, start, count)
 
 

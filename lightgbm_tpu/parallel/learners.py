@@ -64,6 +64,12 @@ class _ParallelTreeLearner(SerialTreeLearner):
             self.cegb_used = None
         self.mesh = mesh if mesh is not None else default_mesh()
         self.num_shards = int(np.prod(self.mesh.devices.shape))
+        if self.mode == "feature" and self.hist_pool_slots:
+            # sharded histogram blocks are F/d wide, so the same
+            # histogram_pool_size budget admits d times more slots than the
+            # serial sizing computed before the mesh was known
+            self.hist_pool_slots = max(2, self.hist_pool_slots
+                                       * self.num_shards)
         self.axis = self.mesh.axis_names[0]
         self.comm = Comm(axis_name=self.axis, mode=self.comm_mode,
                          num_shards=self.num_shards, top_k=int(config.top_k))

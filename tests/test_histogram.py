@@ -83,11 +83,11 @@ def make_rows_store(n, f, b, seed=0, bpc=1, packed=False, W=128):
     (256, 1, False, 11),      # factored 16x16 (max_bin=255)
     (512, 2, False, 5),       # factored 16x32, two-byte codes
     (32, 1, True, 7),         # factored over nibble-packed columns
-    (64, 1, False, 125),      # wide F: classic packed-tile fallback
+    (64, 1, False, 125),      # wide F (multi-M-tile extraction dot)
 ])
 def test_histogram_rows_interpret_matches_xla(b, bpc, packed, f):
-    """histogram_pallas_rows (factored hi/lo MXU path and the classic
-    fallback) vs the backend-agnostic reference, over a sub-window."""
+    """histogram_pallas_rows (factored hi/lo MXU path) vs the
+    backend-agnostic reference, over a sub-window."""
     n = 2048
     rows, voff = make_rows_store(n, f, b, seed=b + f, bpc=bpc, packed=packed,
                                  W=128 if bpc == 1 else 256)
@@ -99,7 +99,23 @@ def test_histogram_rows_interpret_matches_xla(b, bpc, packed, f):
     bins, values = rows_split_xla(jnp.asarray(rows), f, voff, bpc, packed)
     want = np.asarray(histogram_xla_masked(
         bins, values, b, jnp.int32(start), jnp.int32(count)))
-    assert _use_factored(f, b) == (f + 4 <= 124)
+    assert _use_factored(f, b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_rows_classic_fallback(monkeypatch):
+    """The classic packed-tile path stays correct (it serves accumulators
+    past the factored path's 4 MiB VMEM bound, e.g. F > 1024 at B=64)."""
+    import lightgbm_tpu.core.histogram as H
+    monkeypatch.setattr(H, "_use_factored", lambda f, b: False)
+    n, f, b = 2048, 9, 64
+    rows, voff = make_rows_store(n, f, b, seed=1)
+    got = np.asarray(H.histogram_pallas_rows(
+        jnp.asarray(rows), b, jnp.int32(100), jnp.int32(1500),
+        num_features=f, voff=voff, row_tile=1024, interpret=True))
+    bins, values = rows_split_xla(jnp.asarray(rows), f, voff, 1, False)
+    want = np.asarray(histogram_xla_masked(
+        bins, values, b, jnp.int32(100), jnp.int32(1500)))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
