@@ -140,7 +140,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         # put every per-row intermediate in 128x-padded [CHUNK, 1] vregs
         ltri[...] = (jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
                      <= jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
-                     ).astype(jnp.bfloat16)
+                     ).astype(jnp.int8)
 
         def left_dst(nf):
             return pl.multiple_of(wb_al + nf * TS, _ALIGN)
@@ -203,15 +203,16 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             # phase A); the same math lane-packed is ~30 vregs per chunk.
             # Per-subtile totals land in SMEM via ONE DMA (direct vector->
             # scalar extraction costs ~0.7us EACH and does not pipeline).
+            # the streamed tile is used ONLY through i8 x i8 -> i32 MXU
+            # dots (probed exact on v5e), so a zero-cost bitcast VIEW
+            # replaces the round-4/5 u8 -> i32 -> bf16 tile converts;
+            # signed-byte wrap is undone with & 255 after each dot
             if "convert" in dbg_skip:          # profiling: stream-only floor
-                ti_chunk = jnp.zeros((CHUNK, W), jnp.int32)
-                ti_bf = jnp.zeros((CHUNK, W), jnp.bfloat16)
+                ti_i8 = jnp.zeros((CHUNK, W), jnp.int8)
             elif "statslot" in dbg_skip:       # profiling: static buffer read
-                ti_chunk = inbuf[0].astype(jnp.int32)
-                ti_bf = ti_chunk.astype(jnp.bfloat16)
+                ti_i8 = jax.lax.bitcast_convert_type(inbuf[0], jnp.int8)
             else:
-                ti_chunk = inbuf[slot].astype(jnp.int32)     # [CHUNK, W]
-                ti_bf = ti_chunk.astype(jnp.bfloat16)        # hoisted for B
+                ti_i8 = jax.lax.bitcast_convert_type(inbuf[slot], jnp.int8)
             # ONE MXU dot extracts the split column for the whole chunk —
             # TRANSPOSED ([2, W] @ [CHUNK, W]^T -> [2, CHUNK]) so the i32
             # conversion and the packed reshape stay lane-major.  Byte values
@@ -219,28 +220,28 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             # way in the post-partition histogram pass.
             lanes_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
             if packed:
-                colsel = (lanes_w == gcol // 2).astype(jnp.bfloat16)
-                colsel2 = jnp.zeros((1, W), jnp.bfloat16)
+                colsel = (lanes_w == gcol // 2).astype(jnp.int8)
+                colsel2 = jnp.zeros((1, W), jnp.int8)
             elif bpc == 2:
-                colsel = (lanes_w == 2 * gcol).astype(jnp.bfloat16)
-                colsel2 = (lanes_w == 2 * gcol + 1).astype(jnp.bfloat16)
+                colsel = (lanes_w == 2 * gcol).astype(jnp.int8)
+                colsel2 = (lanes_w == 2 * gcol + 1).astype(jnp.int8)
             else:
-                colsel = (lanes_w == gcol).astype(jnp.bfloat16)
-                colsel2 = jnp.zeros((1, W), jnp.bfloat16)
+                colsel = (lanes_w == gcol).astype(jnp.int8)
+                colsel2 = jnp.zeros((1, W), jnp.int8)
             if "extract" in dbg_skip:          # profiling: no extract/route
                 col_p = jnp.zeros((npk, _LANE), jnp.int32)
             else:
                 wmat = jnp.concatenate([colsel, colsel2], axis=0)    # [2, W]
-                extT = jax.lax.dot_general(
-                    wmat, ti_bf, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)      # [2, CHUNK]
-                extTi = extT.astype(jnp.int32)
-                lo_p = extTi[0:1, :].reshape(npk, _LANE)
+                extTi = jax.lax.dot_general(
+                    wmat, ti_i8, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32)        # [2, CHUNK]
+                lo_p = extTi[0:1, :].reshape(npk, _LANE) & 255
                 if packed:
                     col_p = jnp.where(gcol % 2 == 1, (lo_p >> 4) & 15,
                                       lo_p & 15)
                 elif bpc == 2:
-                    col_p = lo_p | (extTi[1:2, :].reshape(npk, _LANE) << 8)
+                    col_p = lo_p | ((extTi[1:2, :].reshape(npk, _LANE)
+                                     & 255) << 8)
                 else:
                     col_p = lo_p
             gl_p = _route_tile(col_p, scal_ref, num_bins)    # [npk, 128]
@@ -263,15 +264,15 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 S_L = selL_p.reshape(nsub, T)
                 S_R = selR_p.reshape(nsub, T)
             if "prefix" in dbg_skip:           # profiling: no prefix/totals
-                pfxU = jnp.zeros((2 * nsub, T), jnp.float32)
+                pfxU = jnp.zeros((2 * nsub, T), jnp.int32)
                 excl_col = jnp.zeros((2 * nsub, 1), jnp.float32)
                 cpt = None
             else:
-                S = jnp.concatenate([S_L, S_R], axis=0).astype(jnp.bfloat16)
+                S = jnp.concatenate([S_L, S_R], axis=0).astype(jnp.int8)
                 pfxU = jax.lax.dot_general(
                     S, ltri[...], (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)      # [2*nsub, T]
-                tot_col = pfxU[:, T - 1:T]                   # [2*nsub, 1]
+                    preferred_element_type=jnp.int32)        # [2*nsub, T]
+                tot_col = pfxU[:, T - 1:T].astype(jnp.float32)
                 # per-side cumulative totals (lower-tri within each block)
                 iiB = jax.lax.broadcasted_iota(jnp.int32, (2 * nsub, 1), 0)
                 jjB = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * nsub), 1)
@@ -287,6 +288,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                     cpt = None
                 else:
                     totals_vm[0:2 * nsub, 0:1] = tot_col.astype(jnp.int32)
+                # (tot_col <= T = 128 is bf16-exact for the triB dot above)
                     totals_vm[0:2 * nsub, 1:2] = incl_col.astype(jnp.int32)
                     cpt = pltpu.make_async_copy(totals_vm, totals_sm,
                                                 sem_tot)
@@ -300,21 +302,21 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             for s in range(nsub) if "phaseB" not in dbg_skip else []:
                 selLs = S_L[s:s + 1, :]                      # [1, T] i32
                 selRs = S_R[s:s + 1, :]
-                pfxLs = pfxU[s:s + 1, :].astype(jnp.int32)   # [1, T]
-                pfxRs = pfxU[nsub + s:nsub + s + 1, :].astype(jnp.int32)
+                pfxLs = pfxU[s:s + 1, :]                     # [1, T] i32
+                pfxRs = pfxU[nsub + s:nsub + s + 1, :]
                 bL = excl_col[s:s + 1, 0:1].astype(jnp.int32)
                 bR = excl_col[nsub + s:nsub + s + 1, 0:1].astype(jnp.int32)
                 destL = jax.lax.rem(headL + fillL + bL + pfxLs - 1, TS)
                 destR = TS + jax.lax.rem(fillR + bR + pfxRs - 1, TS)
                 dest = jnp.where(selLs == 1, destL,
                                  jnp.where(selRs == 1, destR, 2 * TS))
-                Pt = (dest == iota2ts1).astype(jnp.bfloat16)     # [2TS, T]
-                comp_f = jax.lax.dot_general(
-                    Pt, ti_bf[s * T:(s + 1) * T, :],
+                Pt = (dest == iota2ts1).astype(jnp.int8)         # [2TS, T]
+                comp_i = jax.lax.dot_general(
+                    Pt, ti_i8[s * T:(s + 1) * T, :],
                     (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)          # [2TS, W]
-                comp_buf[s * 2 * TS:(s + 1) * 2 * TS, :] = comp_f.astype(
-                    jnp.int32).astype(jnp.uint8)
+                    preferred_element_type=jnp.int32)            # [2TS, W]
+                comp_buf[s * 2 * TS:(s + 1) * 2 * TS, :] = (
+                    comp_i & 255).astype(jnp.uint8)
 
             # ---- phase C (scalar-cheap): blends + flushes from SMEM totals
             if cpt is None:                    # "prefix" knockout (profiling)
@@ -550,7 +552,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             rot[...] = (jax.lax.rem(
                 jax.lax.broadcasted_iota(jnp.int32, (TS, 1), 0) + ph, TS)
                 == jax.lax.broadcasted_iota(jnp.int32, (1, TS), 1)
-            ).astype(jnp.bfloat16)
+            ).astype(jnp.int8)
             # head prefill: keep rows [d_al, d0) (tail of the left block)
             cph = pltpu.make_async_copy(
                 rows_ref.at[pl.ds(d_al, _ALIGN)],
@@ -579,10 +581,10 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
                 tr = jax.lax.dot_general(
                     rot[...],
-                    tmp[slot, :, :].astype(jnp.int32).astype(jnp.bfloat16),
+                    jax.lax.bitcast_convert_type(tmp[slot, :, :], jnp.int8),
                     (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                comp = tr.astype(jnp.int32).astype(jnp.uint8)    # [TS, W]
+                    preferred_element_type=jnp.int32)
+                comp = (tr & 255).astype(jnp.uint8)              # [TS, W]
                 nvs = jnp.minimum(nr - k * TS, TS)
                 # valid source rows j < nvs sit at p=(ph+j)%TS
                 pj = jax.lax.rem(iota_ts - ph + TS, TS)          # j of pos p
@@ -716,8 +718,8 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
             scratch_shapes=[
                 pltpu.VMEM((2, CHUNK, W), jnp.uint8),    # streamed chunks
                 pltpu.VMEM((2 * NB, TS, W), jnp.uint8),  # L/R flush rings
-                pltpu.VMEM((T, T), jnp.bfloat16),        # upper-tri prefix ones
-                pltpu.VMEM((TS, TS), jnp.bfloat16),      # copy-back rotation
+                pltpu.VMEM((T, T), jnp.int8),            # upper-tri prefix ones
+                pltpu.VMEM((TS, TS), jnp.int8),          # copy-back rotation
                 pltpu.VMEM((2, TS, W), jnp.uint8),       # RMW/cb-read bounce
                 pltpu.VMEM((2 * TS * (CHUNK // T), W), jnp.uint8),  # placed
                 pltpu.VMEM((128, 2), jnp.int32),         # subtile totals
