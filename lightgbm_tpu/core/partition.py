@@ -214,10 +214,11 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             else:
                 ti_i8 = jax.lax.bitcast_convert_type(inbuf[slot], jnp.int8)
             # ONE MXU dot extracts the split column for the whole chunk —
-            # TRANSPOSED ([2, W] @ [CHUNK, W]^T -> [2, CHUNK]) so the i32
-            # conversion and the packed reshape stay lane-major.  Byte values
-            # <= 255 are exact in bf16; the g/h bytes are extracted the same
-            # way in the post-partition histogram pass.
+            # TRANSPOSED ([2, W] @ [CHUNK, W]^T -> [2, CHUNK]) so the
+            # result and the packed reshape stay lane-major; i8 x i8 -> i32
+            # with & 255 undoing the signed-byte wrap.  (The post-partition
+            # histogram pass still extracts via bf16 dots: its value path
+            # needs bf16 operands anyway.)
             lanes_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
             if packed:
                 colsel = (lanes_w == gcol // 2).astype(jnp.int8)
@@ -272,6 +273,8 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 pfxU = jax.lax.dot_general(
                     S, ltri[...], (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.int32)        # [2*nsub, T]
+                # per-subtile totals <= T = 128, so the f32/bf16 hop
+                # for the tiny cross-subtile triB dot stays exact
                 tot_col = pfxU[:, T - 1:T].astype(jnp.float32)
                 # per-side cumulative totals (lower-tri within each block)
                 iiB = jax.lax.broadcasted_iota(jnp.int32, (2 * nsub, 1), 0)
@@ -288,7 +291,6 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                     cpt = None
                 else:
                     totals_vm[0:2 * nsub, 0:1] = tot_col.astype(jnp.int32)
-                # (tot_col <= T = 128 is bf16-exact for the triB dot above)
                     totals_vm[0:2 * nsub, 1:2] = incl_col.astype(jnp.int32)
                     cpt = pltpu.make_async_copy(totals_vm, totals_sm,
                                                 sem_tot)
