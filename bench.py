@@ -83,7 +83,7 @@ def measure(X, y, X_test, y_test, *, max_bin, leaves, iters):
     B = _pad_bins_pow2(max_bin + 1)
     if _use_factored(f, B):
         # factored hi/lo path: each group contracts a [4*p*nhi, R] x
-        # [R, p*nlo] all-pairs block (histogram._accum_factored_T)
+        # [R, p*nlo] all-pairs block (histogram._accum_factored_group)
         nhi, nlo = _hilo_factors(B)
         p, G = _factored_geometry(f, B)
         hist_macs_per_row = G * (4 * p * nhi) * (p * nlo)
@@ -163,6 +163,22 @@ def main() -> None:
                "value_63": r63["value"],
                "vs_baseline_63": r63["vs_baseline"],
                "auc_63": r63["auc"]}
+    if os.environ.get("BENCH_WIDEF", "0") == "1":
+        # opt-in: the F=968 grid-over-groups measurement (PERF.md "Wide-F")
+        # in a subprocess so a pathological compile cannot hang the bench
+        import subprocess
+        try:
+            p = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "bench_widef.py"), "--json"],
+                capture_output=True, text=True, timeout=1800)
+            if p.returncode == 0 and p.stdout.strip():
+                out["widef"] = json.loads(p.stdout.strip().splitlines()[-1])
+            else:
+                out["widef_error"] = (p.stderr or "no output")[-500:]
+        except Exception as exc:  # timeout/JSON failure must not lose the
+            out["widef_error"] = repr(exc)[-500:]  # main bench results
     print(json.dumps(out))
 
 

@@ -105,7 +105,8 @@ def _bag_mask_for(row_ids, seed: int, it, freq: int, frac: float):
     tests/test_fused_valid_bagging.py."""
     itw = it - jax.lax.rem(it, jnp.int32(freq))
     u = _bag_uniforms(row_ids, seed, itw)
-    mask = (u < jnp.float32(frac)).astype(jnp.float32)
+    # frac may be a per-row array (pos/neg balanced bagging) or a scalar
+    mask = (u < jnp.asarray(frac, jnp.float32)).astype(jnp.float32)
     cnt = jnp.maximum(jnp.sum(mask, dtype=jnp.float32), 1.0).astype(jnp.int32)
     return mask, cnt
 
@@ -316,6 +317,7 @@ class GBDT:
         # cached fused programs close over the old learner/objective
         self._fused_cache = {}
         self._fuse_failed = False
+        self._balanced_frac = None  # labels may have changed
         self.num_tree_per_iteration = (objective.num_model_per_iteration
                                        if objective else max(1, self.num_class))
         self.learner = create_tree_learner(train_data, self.config,
@@ -484,17 +486,42 @@ class GBDT:
 
     # ---- bagging (gbdt.cpp:160-276) ----
 
+    def _balanced_bagging(self) -> bool:
+        """pos/neg_bagging_fraction balanced bagging is active
+        (config.h:261-281: needs bagging_freq > 0 and either class fraction
+        below 1; label > 0 marks the positive class like the reference's
+        BaggingHelper)."""
+        cfg = self.config
+        return (cfg.bagging_freq > 0
+                and (float(cfg.pos_bagging_fraction) < 1.0
+                     or float(cfg.neg_bagging_fraction) < 1.0))
+
     def _bagging(self, it: int) -> None:
         cfg = self.config
-        if (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
-                and it % cfg.bagging_freq == 0):
+        balanced = self._balanced_bagging()
+        plain = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+        if (balanced or plain) and it % cfg.bagging_freq == 0:
             n = self.num_data
+            if balanced:
+                # per-class Bernoulli fractions over the SAME stateless
+                # uniforms as plain bagging (gbdt.cpp:185-206 balanced
+                # bagging; independent-draw semantics as documented on
+                # _bag_uniforms).  Labels and the two fractions are
+                # iteration-invariant, so the [n] array is built once.
+                frac = getattr(self, "_balanced_frac", None)
+                if frac is None:
+                    label = np.asarray(self.train_data.metadata.label)[:n]
+                    frac = jnp.where(jnp.asarray(label > 0),
+                                     jnp.float32(cfg.pos_bagging_fraction),
+                                     jnp.float32(cfg.neg_bagging_fraction))
+                    self._balanced_frac = frac
+            else:
+                frac = float(cfg.bagging_fraction)
             # same stateless hash as the fused path, so fused and
             # per-iteration training produce identical masks
             mask, cnt = _bag_mask_for(
                 jnp.arange(n, dtype=jnp.int32), int(cfg.bagging_seed),
-                jnp.int32(it), int(cfg.bagging_freq),
-                float(cfg.bagging_fraction))
+                jnp.int32(it), int(cfg.bagging_freq), frac)
             self.bag_mask = self.learner.pad_rows(mask)
             self.bag_data_cnt = int(cnt)
         elif self.bag_mask is None:
@@ -644,6 +671,11 @@ class GBDT:
             return False
         cfg = self.config
         if float(cfg.feature_fraction) < 1.0:
+            return False
+        if self._balanced_bagging():
+            # the in-scan mask hashes original row ids against ONE scalar
+            # fraction; per-class fractions need the labels, which do not
+            # ride the (permuted) row store — per-iteration path applies them
             return False
         if getattr(self.learner, "comm", None) is not None:
             return False  # parallel learners keep the per-iteration path

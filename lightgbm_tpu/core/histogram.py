@@ -135,6 +135,84 @@ def _accum_onehot_tiles(col, v4, out_ref, *, num_features: int,
         out_ref[:, t * _LANE:(t + 1) * _LANE] += acc
 
 
+def _accum_onehot_tile_dyn(colf_dyn, v4, out_ref, t, *, num_features: int,
+                           num_bins: int, contract_dim: int):
+    """One 128-lane tile's classic one-hot contraction with a TRACED tile
+    index ``t`` — grid-over-tiles / fori-over-tiles building block of the
+    classic packed-tile histogram (wide-F shapes past the factored path's
+    4 MiB accumulator bound unrolled hundreds of tiles here and blew the
+    compile; program size is now O(1) in F).
+
+    colf_dyn(f) -> per-row bin code of feature f (traced f; [Nt, 1] for
+    contract_dim=0, [1, Nt] lane-major for contract_dim=1)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1)
+    B = num_bins
+    fp = _features_per_tile(B)
+    tpf = max(1, B // _LANE)
+    if B >= _LANE:
+        oh = (colf_dyn(t // tpf) - jax.lax.rem(t, tpf) * _LANE) == iota
+    else:
+        oh = None
+        for j in range(fp):
+            f = t * fp + j
+            m = ((colf_dyn(f) + j * B) == iota) & (f < num_features)
+            oh = m if oh is None else oh | m
+    exact = v4.dtype == jnp.float32
+    acc = jax.lax.dot_general(
+        v4, oh.astype(v4.dtype), (((contract_dim,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST if exact else None)  # [4, 128]
+    off = pl.multiple_of(t * _LANE, _LANE)
+    prev = pl.load(out_ref, (slice(None), pl.ds(off, _LANE)))
+    pl.store(out_ref, (slice(None), pl.ds(off, _LANE)), prev + acc)
+
+
+def _colf_rows_dyn(w, *, bpc: int, packed: bool):
+    """Dynamic-index bin-code extraction from an [Nt, W] row-store tile:
+    a weighted lane reduction (single-lane masks are Mosaic-safe where the
+    shifted-slice OR chain is not, see _f32_from_bytes) so the feature index
+    may be traced.
+
+    ``w`` may be i32 or bf16 (byte values 0..255 are exact in bf16; the
+    classic grid kernel stages its tile as bf16 to halve the VMEM scratch
+    at the wide-W shapes this path exists for) — the single-nonzero lane
+    reduction is exact either way, and integer bit math happens on the
+    reduced [Nt, 1] column."""
+    W = w.shape[1]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    floaty = w.dtype != jnp.int32
+
+    def pick(col_idx):
+        if floaty:
+            m = (lanes == col_idx).astype(w.dtype)
+            return jnp.sum(w * m, axis=1, keepdims=True).astype(jnp.int32)
+        return jnp.sum(w * (lanes == col_idx), axis=1, keepdims=True)
+
+    def colf(f):
+        if packed:
+            return (pick(f // 2) >> (4 * jax.lax.rem(f, 2))) & 15
+        if bpc == 2:
+            return pick(2 * f) | (pick(2 * f + 1) << 8)
+        return pick(f)
+
+    return colf
+
+
+def _accum_onehot_all(colf_dyn, v4, out_ref, *, num_features: int,
+                      num_bins: int, contract_dim: int):
+    """Rolled fori_loop over every 128-lane tile (fused-kernel classic
+    path; the standalone kernel puts tiles on the grid)."""
+    num_tiles = out_ref.shape[1] // _LANE
+
+    def body(t, _):
+        _accum_onehot_tile_dyn(colf_dyn, v4, out_ref, t,
+                               num_features=num_features, num_bins=num_bins,
+                               contract_dim=contract_dim)
+        return 0
+
+    jax.lax.fori_loop(0, num_tiles, body, 0)
+
+
 def _hist_kernel_mxu(win_ref, bins_ref, vals_ref, out_ref, *,
                      num_features: int, num_bins: int, row_tile: int,
                      packed: bool, exact: bool = False):
@@ -244,7 +322,7 @@ def _hilo_factors(num_bins: int):
     factors as ``bin = hi * nlo + lo``, so a B-lane one-hot becomes the outer
     product of an nhi-lane and an nlo-lane one-hot — built with nhi + nlo
     compares per (row, feature) instead of B, with the outer product riding
-    the histogram contraction itself on the MXU (see _accum_factored_T)."""
+    the histogram contraction itself on the MXU (see _accum_factored_group)."""
     nlo = 1
     while nlo * nlo < num_bins:
         nlo *= 2
@@ -271,59 +349,111 @@ def _use_factored(num_features: int, num_bins: int) -> bool:
     the diagonal is read) — per-feature cost near-independent of B, so it
     wins essentially everywhere the accumulator fits on-chip.  The bound
     below caps the [G*128, p*nlo] f32 accumulator at 4 MiB of VMEM (it
-    lives alongside the partition kernel's ~3 MiB of streaming scratch)."""
+    lives alongside the partition kernel's ~5 MiB of round-6 pipelined
+    streaming scratch — NIN=3 input ring + double-banked placement tiles —
+    inside the ~16 MiB v5e VMEM)."""
     if num_bins < 32:
         return False
     out = _factored_out_shape(num_features, num_bins)
     return out[0] * out[1] * 4 <= (4 << 20)
 
 
-def _accum_factored_T(colT_fn, v4T, out_ref, *, num_features: int,
-                      num_bins: int):
-    """Factored-MXU histogram accumulation (transposed layout).
+def _accum_factored_group(ti_bf, v4T, out_ref, g, *, num_features: int,
+                          num_bins: int, bpc: int, packed: bool, f_base=0):
+    """ONE feature group's factored-MXU histogram accumulation, with the
+    group index ``g`` a TRACED scalar — the building block both of the
+    grid-over-groups standalone kernel (g = pl.program_id) and of the fused
+    kernel's rolled ``fori_loop`` over groups.  The round-5 layout unrolled a
+    Python loop over all G groups (and an extraction matrix with one row per
+    FEATURE), which at wide F (Bosch F=968) blew Mosaic compiles past 10
+    minutes; here program size is O(p) regardless of F.
 
-    colT_fn(f) -> [1, R] i32 bin codes of feature f, rows along LANES;
-    v4T: [4, R] (grad_hi, hess_hi, grad_lo, hess_lo), bf16 (or f32 in exact
-    mode); out_ref: [G*128, p*nlo] f32, += accumulated.
+    ti_bf: [R, W] bf16 row-store tile (byte values exact in bf16);
+    v4T: [4, R] (grad_hi, hess_hi, grad_lo, hess_lo) from
+    :func:`_extract_values_T`; out_ref: [G*p*4*nhi, p*nlo] f32 — the group's
+    [p*4*nhi, p*nlo] block is += accumulated at a dynamic sublane offset.
 
-    Replaces the classic B-lane one-hot build (B compares + astypes per
-    (row, feature) — linear in B, the dominant VPU cost of the round-4
-    kernel) with nhi + nlo compares and a [128, R] @ [R, p*nlo] MXU
-    contraction whose p x p feature cross-blocks are discarded except the
-    diagonal.  The value weighting rides the hi side (4 channels x nhi
-    sublane-broadcast multiplies).  Cost is near-independent of B: the
-    255-bin headline costs about the same as 63-bin."""
+    The bin one-hot build costs nhi + nlo compares per (row, feature) —
+    near-independent of B — and the value weighting rides the hi side of a
+    [p*4*nhi, R] @ [R, p*nlo] contraction whose p x p feature cross-blocks
+    are discarded except the diagonal (see _fold_factored)."""
     nhi, nlo = _hilo_factors(num_bins)
-    p, G = _factored_geometry(num_features, num_bins)
-    R = v4T.shape[1]
+    p, _ = _factored_geometry(num_features, num_bins)
     exact = v4T.dtype == jnp.float32
     oh_t = v4T.dtype
+    W = ti_bf.shape[1]
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    f0 = f_base + g * p
+    # dynamic byte-column selection matrix for the group's bin codes: the
+    # row index rides a broadcasted iota compared against the traced f0, so
+    # ONE [nbrow, W] @ [R, W]^T dot extracts the whole group at any F
+    if packed:
+        # p is even for every packed geometry (p = 32 // nhi, nhi <= 8 at
+        # the 32-lane packed block) and callers keep f_base even, so the
+        # group covers whole bytes and nibble parity is q % 2
+        nbrow = max(p // 2, 1)
+        rowsel = (f0 // 2) + jax.lax.broadcasted_iota(
+            jnp.int32, (nbrow, 1), 0)
+    elif bpc == 2:
+        nbrow = 2 * p
+        k2 = jax.lax.broadcasted_iota(jnp.int32, (nbrow, 1), 0)
+        rowsel = 2 * (f0 + k2 // 2) + jax.lax.rem(k2, 2)
+    else:
+        nbrow = p
+        rowsel = f0 + jax.lax.broadcasted_iota(jnp.int32, (nbrow, 1), 0)
+    E = (iota_w == rowsel).astype(jnp.bfloat16)            # [nbrow, W]
+    colsT = jax.lax.dot_general(
+        E, ti_bf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.int32)   # [nbrow, R]
     iota_hi = jax.lax.broadcasted_iota(jnp.int32, (nhi, 1), 0)
     iota_lo = jax.lax.broadcasted_iota(jnp.int32, (nlo, 1), 0)
     sh = nlo.bit_length() - 1
-    for g in range(G):
-        a_blocks = []
-        lo_blocks = []
-        for q in range(p):
-            f = g * p + q
-            if f < num_features:
-                colf = colT_fn(f)                          # [1, R] i32
-                hi_oh = ((colf >> sh) == iota_hi).astype(oh_t)   # [nhi, R]
-                lo_oh = ((colf & (nlo - 1)) == iota_lo).astype(oh_t)
-                for c in range(4):
-                    a_blocks.append(v4T[c:c + 1, :] * hi_oh)
-                lo_blocks.append(lo_oh)
-            else:
-                a_blocks.append(jnp.zeros((4 * nhi, R), oh_t))
-                lo_blocks.append(jnp.zeros((nlo, R), oh_t))
-        a_big = jnp.concatenate(a_blocks, axis=0)          # [p*4*nhi, R]
-        lo_big = jnp.concatenate(lo_blocks, axis=0)        # [p*nlo, R]
-        acc = jax.lax.dot_general(
-            a_big, lo_big, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST if exact else None)
-        rows = a_big.shape[0]
-        out_ref[g * rows:(g + 1) * rows, :] += acc
+    a_blocks = []
+    lo_blocks = []
+    for q in range(p):
+        if packed:
+            byte = colsT[q // 2:q // 2 + 1, :]
+            colf = (byte >> (4 * (q % 2))) & 15
+        elif bpc == 2:
+            colf = colsT[2 * q:2 * q + 1, :] | (colsT[2 * q + 1:2 * q + 2, :]
+                                                << 8)
+        else:
+            colf = colsT[q:q + 1, :]
+        # num_features is the histogrammed WINDOW's width (f_base is the
+        # absolute byte offset of its first feature), so validity is local
+        valid = g * p + q < num_features       # traced bool scalar: the last
+        hi_oh = (colf >> sh) == iota_hi        # group's tail features mask
+        lo_oh = (colf & (nlo - 1)) == iota_lo  # to zero contribution
+        hi_oh = jnp.where(valid, hi_oh, False).astype(oh_t)   # [nhi, R]
+        lo_oh = jnp.where(valid, lo_oh, False).astype(oh_t)   # [nlo, R]
+        for c in range(4):
+            a_blocks.append(v4T[c:c + 1, :] * hi_oh)
+        lo_blocks.append(lo_oh)
+    a_big = jnp.concatenate(a_blocks, axis=0)              # [p*4*nhi, R]
+    lo_big = jnp.concatenate(lo_blocks, axis=0)            # [p*nlo, R]
+    acc = jax.lax.dot_general(
+        a_big, lo_big, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST if exact else None)
+    rows = a_big.shape[0]
+    off = pl.multiple_of(g * rows, rows)
+    prev = pl.load(out_ref, (pl.ds(off, rows), slice(None)))
+    pl.store(out_ref, (pl.ds(off, rows), slice(None)), prev + acc)
+
+
+def _accum_factored_all(ti_bf, v4T, out_ref, *, num_features: int,
+                        num_bins: int, bpc: int, packed: bool, f_base=0):
+    """Rolled loop over every feature group (the fused partition kernel's
+    in-kernel histogram; the standalone kernel puts groups on the grid)."""
+    _, G = _factored_geometry(num_features, num_bins)
+
+    def body(g, _):
+        _accum_factored_group(ti_bf, v4T, out_ref, g,
+                              num_features=num_features, num_bins=num_bins,
+                              bpc=bpc, packed=packed, f_base=f_base)
+        return 0
+
+    jax.lax.fori_loop(0, G, body, 0)
 
 
 def _fold_factored(raw, num_features: int, num_bins: int):
@@ -345,83 +475,42 @@ def _factored_out_shape(num_features: int, num_bins: int):
     return (G * p * 4 * nhi, p * nlo)
 
 
-def _extract_T(ti_bf, *, num_features: int, voff: int, bpc: int,
-               packed: bool, exact: bool, inwT=None, f_base=0):
-    """Transposed extraction: bin codes + g/h from a [R, W] bf16 row-store
-    tile in ONE [M, W] @ [R, W]^T dot (byte values are exact in bf16; the
-    g/h f32s are rebuilt from two 16-bit halves so f32 accumulation is
-    exact).  Returns (colT_fn, v4T) for _accum_factored_T.
+def _extract_values_T(ti_bf, *, voff: int, exact: bool, inwT=None):
+    """Transposed g/h extraction from a [R, W] bf16 row-store tile: ONE
+    [4, W] @ [R, W]^T dot pulls the four 16-bit halves, the f32s are rebuilt
+    via i32 OR (the wrap restores the sign bit; the OBVIOUS shifted-slice OR
+    chain is miscompiled on v5e — see _f32_from_bytes), and the hi/lo bf16
+    split makes the v4T operand of :func:`_accum_factored_group`.
 
-    ``f_base``: first feature of the extracted window (traced scalar ok) —
-    feature-parallel shards histogram only their own F/d block
-    (feature_parallel_tree_learner.cpp:33-52) while the row store keeps
-    every routable column.  Requires f_base to be byte-aligned for the
-    packed-nibble layout (callers shard in whole-byte multiples).
-
-    Keeping every per-row intermediate LANE-major ([k, R]) matters as much
-    as the dot itself: sliced [R, 1] intermediates are 128x vreg-padded."""
-    R, W = ti_bf.shape
+    The per-group bin extraction moved into _accum_factored_group itself
+    (dynamic group index); values are extracted ONCE per tile and reused by
+    every group.  Keeping every per-row intermediate LANE-major ([k, R])
+    matters as much as the dot: sliced [R, 1] intermediates are 128x
+    vreg-padded."""
+    W = ti_bf.shape[1]
     f32 = jnp.float32
     iota_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
-    rows = []
-    if packed:
-        for f in range(0, num_features, 2):
-            rows.append((iota_w == (f_base + f) // 2))
-        ncol_rows = len(rows)
-    elif bpc == 2:
-        for f in range(num_features):
-            rows.append((iota_w == 2 * (f_base + f)))
-        for f in range(num_features):
-            rows.append((iota_w == 2 * (f_base + f) + 1))
-        ncol_rows = num_features
-    else:
-        for f in range(num_features):
-            rows.append((iota_w == f_base + f))
-        ncol_rows = num_features
-    # g/h as two 16-bit halves each (i32 wrap restores the sign bit; the
-    # OBVIOUS shifted-slice OR chain is miscompiled on v5e — see
-    # _f32_from_bytes)
-    for off in (voff, voff + 2, voff + 4, voff + 6):
-        rows.append((iota_w == off) * 1 + (iota_w == off + 1) * 256)
-    E = jnp.concatenate(rows, axis=0).astype(jnp.bfloat16)   # [M, W]
-    allT = jax.lax.dot_general(
+    rows = [(iota_w == off) * 1 + (iota_w == off + 1) * 256
+            for off in (voff, voff + 2, voff + 4, voff + 6)]
+    E = jnp.concatenate(rows, axis=0).astype(jnp.bfloat16)   # [4, W]
+    allTi = jax.lax.dot_general(
         E, ti_bf, (((1,), (1,)), ((), ())),
-        preferred_element_type=f32)                          # [M, R]
-    allTi = allT.astype(jnp.int32)
-    nghr = allTi.shape[0] - 4
+        preferred_element_type=f32).astype(jnp.int32)        # [4, R]
     g_w = jax.lax.bitcast_convert_type(
-        allTi[nghr:nghr + 1, :] | (allTi[nghr + 1:nghr + 2, :] << 16), f32)
+        allTi[0:1, :] | (allTi[1:2, :] << 16), f32)
     h_w = jax.lax.bitcast_convert_type(
-        allTi[nghr + 2:nghr + 3, :] | (allTi[nghr + 3:nghr + 4, :] << 16),
-        f32)
+        allTi[2:3, :] | (allTi[3:4, :] << 16), f32)
     if inwT is not None:
         g_w = g_w * inwT
         h_w = h_w * inwT
     if exact:
-        v4T = jnp.concatenate(
+        return jnp.concatenate(
             [g_w, h_w, jnp.zeros_like(g_w), jnp.zeros_like(h_w)], axis=0)
-    else:
-        g_hi = g_w.astype(jnp.bfloat16)
-        h_hi = h_w.astype(jnp.bfloat16)
-        g_lo = (g_w - g_hi.astype(f32)).astype(jnp.bfloat16)
-        h_lo = (h_w - h_hi.astype(f32)).astype(jnp.bfloat16)
-        v4T = jnp.concatenate([g_hi, h_hi, g_lo, h_lo], axis=0)
-
-    if packed:
-        def colT_fn(f):
-            # row k of E covers byte (f_base + 2k) // 2; nibble parity is
-            # GLOBAL ((f_base + f) % 2) — callers keep f_base even so the
-            # two halves of a byte stay in one shard
-            byte = allTi[f // 2:f // 2 + 1, :]
-            return (byte >> (4 * ((f_base + f) % 2))) & 15
-    elif bpc == 2:
-        def colT_fn(f):
-            return (allTi[f:f + 1, :]
-                    | (allTi[ncol_rows + f:ncol_rows + f + 1, :] << 8))
-    else:
-        def colT_fn(f):
-            return allTi[f:f + 1, :]
-    return colT_fn, v4T
+    g_hi = g_w.astype(jnp.bfloat16)
+    h_hi = h_w.astype(jnp.bfloat16)
+    g_lo = (g_w - g_hi.astype(f32)).astype(jnp.bfloat16)
+    h_lo = (h_w - h_hi.astype(f32)).astype(jnp.bfloat16)
+    return jnp.concatenate([g_hi, h_hi, g_lo, h_lo], axis=0)
 
 
 def _f32_from_bytes(ti, off: int):
@@ -444,78 +533,97 @@ def _f32_from_bytes(ti, off: int):
     return jax.lax.bitcast_convert_type(word, jnp.float32)
 
 
-def _hist_kernel_rows(win_ref, rows_ref, out_ref, *, num_features: int,
-                      num_bins: int, row_tile: int, packed: bool,
-                      voff: int, bpc: int, exact: bool = False):
-    """Combined-row-store histogram: ``rows`` is [Nt, W] u8 with bin codes in
-    bytes [0, num_cols*bpc), grad/hess f32 little-endian at byte offsets
-    voff/voff+4.  One operand means the partitioned tree builder carries ONE
-    unpadded byte matrix (128-lane rows) instead of separate bins/values
-    arrays whose small-minor-dim layouts XLA pads 4-64x."""
-    i = pl.program_id(0)
+def _hist_kernel_rows(win_ref, rows_ref, out_ref, w_sc, v4_sc, *,
+                      num_features: int, num_bins: int, row_tile: int,
+                      packed: bool, voff: int, bpc: int,
+                      exact: bool = False):
+    """Combined-row-store histogram, classic packed tiles, GRID over lane
+    tiles: grid = (row tiles, output tiles).  ``rows`` is [Nt, W] u8 with
+    bin codes in bytes [0, num_cols*bpc), grad/hess f32 little-endian at
+    byte offsets voff/voff+4.  One operand means the partitioned tree
+    builder carries ONE unpadded byte matrix (128-lane rows) instead of
+    separate bins/values arrays whose small-minor-dim layouts XLA pads
+    4-64x.
 
-    @pl.when(i == 0)
+    The tile index is pl.program_id(1) — program size is O(1) in F, which is
+    what lets wide-F x 256-bin shapes (Bosch past the factored 4 MiB gate)
+    compile in minutes instead of not at all.  The i32 tile and the hi/lo
+    value operand are computed once per row tile (at t == 0) into VMEM
+    scratch and reused by every output tile."""
+    i = pl.program_id(0)
+    t = pl.program_id(1)
+
+    @pl.when((i == 0) & (t == 0))
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
     start, count = win_ref[0], win_ref[1]
     base = i * row_tile
+    active = (base < start + count) & (base + row_tile > start)
 
-    @pl.when((base < start + count) & (base + row_tile > start))
-    def _accum():
+    @pl.when(active & (t == 0))
+    def _stage_tile():
         w = rows_ref[...].astype(jnp.int32)              # [Nt, W]
+        # bf16 staging: byte values are exact in bf16 and the scratch is
+        # half the i32 footprint — at the wide-W shapes this kernel exists
+        # for (F=968 x 256 bins: W=1024) an i32 stage alone would be 8 MiB
+        # of the ~16 MiB VMEM
+        w_sc[...] = w.astype(jnp.bfloat16)
         pos = base + jax.lax.broadcasted_iota(jnp.int32, (row_tile, 1), 0)
         in_w = (pos >= start) & (pos < start + count)
-
         zero = jnp.float32(0.0)
         g = jnp.where(in_w, _f32_from_bytes(w, voff), zero)
         h = jnp.where(in_w, _f32_from_bytes(w, voff + 4), zero)
         vals = jnp.concatenate([g, h], axis=1)           # [Nt, 2] f32
-        v4 = _hilo_split(vals, axis=1, exact=exact)      # [Nt, 4]
+        v4_sc[...] = _hilo_split(vals, axis=1, exact=exact)  # [Nt, 4]
 
-        def col(f):
-            # classic path keeps static column slices: the feature window
-            # (win_ref[2]) is only supported on the factored path; the
-            # learner only shards histogram construction when the sharded
-            # width passes _use_factored (4 MiB accumulator bound), else it
-            # falls back to a replicated build with a sharded scan
-            if packed:
-                return (w[:, f // 2:f // 2 + 1] >> (4 * (f % 2))) & 15
-            if bpc == 2:
-                return w[:, 2 * f:2 * f + 1] | (w[:, 2 * f + 1:2 * f + 2] << 8)
-            return w[:, f:f + 1]
-
-        _accum_onehot_tiles(col, v4, out_ref, num_features=num_features,
-                            num_bins=num_bins, contract_dim=0)
+    @pl.when(active)
+    def _accum():
+        # the feature window (win_ref[2]) is only supported on the factored
+        # path; the learner only shards histogram construction when the
+        # sharded width passes _use_factored, else it falls back to a
+        # replicated build with a sharded scan
+        colf = _colf_rows_dyn(w_sc[...], bpc=bpc, packed=packed)
+        _accum_onehot_tile_dyn(colf, v4_sc[...], out_ref, t,
+                               num_features=num_features,
+                               num_bins=num_bins, contract_dim=0)
 
 
-def _hist_kernel_rows_fac(win_ref, rows_ref, out_ref, *, num_features: int,
-                          num_bins: int, row_tile: int, packed: bool,
-                          voff: int, bpc: int, exact: bool = False):
-    """Factored-MXU variant of _hist_kernel_rows: transposed extraction +
-    hi/lo outer-product accumulation (see _accum_factored_T).  out_ref:
-    [G*128, p*nlo] f32 — fold with _fold_factored.  win_ref[2] is the
-    feature-window base (feature-parallel shards)."""
+def _hist_kernel_rows_fac(win_ref, rows_ref, out_ref, tib_sc, v4_sc, *,
+                          num_features: int, num_bins: int, row_tile: int,
+                          packed: bool, voff: int, bpc: int,
+                          exact: bool = False):
+    """Factored-MXU variant of _hist_kernel_rows, GRID over feature groups:
+    grid = (row tiles, G), one [p*4*nhi, R] @ [R, p*nlo] group block per
+    step (see _accum_factored_group).  out_ref: [G*128, p*nlo] f32 — fold
+    with _fold_factored.  win_ref[2] is the feature-window base
+    (feature-parallel shards).  The bf16 tile and the v4T value operand are
+    staged once per row tile (at g == 0) and reused by every group."""
     i = pl.program_id(0)
+    g = pl.program_id(1)
 
-    @pl.when(i == 0)
+    @pl.when((i == 0) & (g == 0))
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
     start, count = win_ref[0], win_ref[1]
     base = i * row_tile
+    active = (base < start + count) & (base + row_tile > start)
 
-    @pl.when((base < start + count) & (base + row_tile > start))
-    def _accum():
-        ti_bf = rows_ref[...].astype(jnp.int32).astype(jnp.bfloat16)
+    @pl.when(active & (g == 0))
+    def _stage_tile():
+        tib_sc[...] = rows_ref[...].astype(jnp.int32).astype(jnp.bfloat16)
         posT = base + jax.lax.broadcasted_iota(jnp.int32, (1, row_tile), 1)
         inwT = ((posT >= start).astype(jnp.float32)
                 * (posT < start + count).astype(jnp.float32))
-        colT_fn, v4T = _extract_T(ti_bf, num_features=num_features,
-                                  voff=voff, bpc=bpc, packed=packed,
-                                  exact=exact, inwT=inwT, f_base=win_ref[2])
-        _accum_factored_T(colT_fn, v4T, out_ref,
-                          num_features=num_features, num_bins=num_bins)
+        v4_sc[...] = _extract_values_T(tib_sc[...], voff=voff, exact=exact,
+                                       inwT=inwT)
+
+    @pl.when(active)
+    def _accum():
+        _accum_factored_group(tib_sc[...], v4_sc[...], out_ref, g,
+                              num_features=num_features, num_bins=num_bins,
+                              bpc=bpc, packed=packed, f_base=win_ref[2])
 
 
 @functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
@@ -547,23 +655,31 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
         "f_begin needs the factored histogram path"
     win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32),
                      jnp.asarray(f_begin, jnp.int32)])
+    v4_dtype = jnp.float32 if exact else jnp.bfloat16
 
-    def _in_idx(i, win_ref):
+    def _in_idx(i, g, win_ref):
+        # tiles outside the window revisit block 0 (Mosaic elides the
+        # re-fetch); the group/tile grid axis never moves the input block
         active = ((i * row_tile < win_ref[0] + win_ref[1])
                   & ((i + 1) * row_tile > win_ref[0]))
         return (jnp.where(active, i, 0), 0)
 
     if _use_factored(num_features, num_bins):
         out_shape = _factored_out_shape(num_features, num_bins)
+        _, G = _factored_geometry(num_features, num_bins)
         kernel = functools.partial(
             _hist_kernel_rows_fac, num_features=num_features,
             num_bins=num_bins, row_tile=row_tile, packed=packed, voff=voff,
             bpc=bpc, exact=exact)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(n // row_tile,),
+            grid=(n // row_tile, G),
             in_specs=[pl.BlockSpec((row_tile, width), _in_idx)],
-            out_specs=pl.BlockSpec(out_shape, lambda i, w: (0, 0)),
+            out_specs=pl.BlockSpec(out_shape, lambda i, g, w: (0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((row_tile, width), jnp.bfloat16),  # staged tile
+                pltpu.VMEM((4, row_tile), v4_dtype),          # v4T values
+            ],
         )
         raw = pl.pallas_call(
             kernel,
@@ -573,6 +689,13 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
         )(win, rows)
         return _fold_factored(raw, num_features, num_bins)
 
+    # classic path: in practice only wide-F shapes land here (kernel bin
+    # widths are padded to >= 32, so every narrow-F accumulator passes the
+    # factored 4 MiB gate); at wide W keep the VMEM budget sane by
+    # shrinking the row tile (input block + bf16 stage scale with both)
+    if width > 512:
+        while row_tile > 1024 and n % (row_tile // 2) == 0:
+            row_tile //= 2
     f_pad = _padded_features(num_features, num_bins)
     lanes = f_pad * num_bins
     kernel = functools.partial(_hist_kernel_rows, num_features=num_features,
@@ -581,9 +704,13 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
                                exact=exact)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n // row_tile,),
+        grid=(n // row_tile, lanes // _LANE),
         in_specs=[pl.BlockSpec((row_tile, width), _in_idx)],
-        out_specs=pl.BlockSpec((4, lanes), lambda i, w: (0, 0)),
+        out_specs=pl.BlockSpec((4, lanes), lambda i, t, w: (0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((row_tile, width), jnp.bfloat16),      # staged tile
+            pltpu.VMEM((row_tile, 4), v4_dtype),              # hi/lo values
+        ],
     )
     raw = pl.pallas_call(
         kernel,

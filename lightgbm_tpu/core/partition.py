@@ -25,6 +25,14 @@ learner's copy/kernel overlap (src/treelearner/gpu_tree_learner.cpp:952-1055)
   trick feeds on it) accumulates in the same pass from the same VMEM tiles —
   the routing/scatter/histogram fusion PERF.md round 3 listed as the next
   lever.
+- Round 6: the chunk loop is SOFTWARE-PIPELINED — phase C (scalar blends +
+  flushes) trails one chunk behind phases A/B on double-banked totals and
+  placement buffers, so the per-chunk totals VMEM->SMEM round-trip and the
+  flush-semaphore waits overlap the next chunk's matmuls instead of
+  stalling them (round 5 measured phase A at ~10x its isolated compute
+  replica, all scheduling); the per-feature-group histogram loops are
+  ROLLED (dynamic group index) so program size stays O(1) in F and wide-F
+  row stores compile.
 
 Mosaic constraints honored (probed on v5e): no u8 vector arithmetic (u8 used
 only for DMA/select; math in i32/bf16/f32), no dynamic sublane rotate on u8
@@ -41,7 +49,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .histogram import (_accum_factored_T, _accum_onehot_tiles, _extract_T,
+from .histogram import (_accum_factored_all, _accum_onehot_all,
+                        _colf_rows_dyn, _extract_values_T,
                         _factored_out_shape, _fold_factored, _hilo_split,
                         _padded_features, _use_factored, histogram_xla_masked,
                         rows_split_xla)
@@ -61,12 +70,16 @@ TS = 128             # staging/flush tile (rows per contiguous write-back)
 # in [CHUNK, 1] subtile slicing).
 NB = 36              # flush-ring depth per stream (>= CHUNK/TS + 2 so a
                      # whole chunk can blend before its flushes start)
+NIN = 3              # input-chunk ring depth: two reads in flight so the
+                     # read DMA wait overlaps the previous chunk's phase
+                     # A/B matmuls AND the one-behind phase C (round 6)
 # The single-flush circular staging depends on nls <= TS per subtile (at most
 # one stage wrap per append) and the subtile loop covering the chunk exactly;
 # retuning one constant without the other silently corrupts the partition.
 assert T == TS and CHUNK % T == 0 and T % _ALIGN == 0 and TS % _ALIGN == 0
 assert NB * TS >= CHUNK + 2 * TS
-assert 2 * (CHUNK // T) <= 128, "subtile totals must fit one [128, 2] SMEM tile"
+assert NIN >= 2
+assert 2 * (CHUNK // T) <= 128, "subtile totals must fit one [128, 2] SMEM bank"
 
 
 def _route_tile(col, scal_ref, num_bins):
@@ -153,14 +166,23 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         cp.start()
         cp.wait()
 
-        @pl.when(nchunks > 0)
-        def _prologue():
-            pltpu.make_async_copy(
-                rows_ref.at[pl.ds(wb_al, CHUNK)], inbuf.at[0], sem_in.at[0]
-            ).start()
+        # deepened input ring: NIN - 1 reads in flight, so the chunk-read
+        # semaphore wait overlaps the previous chunk's phase A/B matmuls and
+        # the one-behind phase C (software pipeline below)
+        for j in range(NIN - 1):
+            @pl.when(j < nchunks)
+            def _prologue(j=j):
+                pltpu.make_async_copy(
+                    rows_ref.at[pl.ds(
+                        pl.multiple_of(wb_al + j * CHUNK, _ALIGN), CHUNK)],
+                    inbuf.at[j], sem_in.at[j]).start()
 
         iota2ts1 = jax.lax.broadcasted_iota(jnp.int32, (2 * TS, 1), 0)
         iota_ts = jax.lax.broadcasted_iota(jnp.int32, (TS, 1), 0)
+        totals_on = "totals" not in dbg_skip and "prefix" not in dbg_skip
+        nsub = CHUNK // T
+        npk = CHUNK // _LANE                   # lane-packed rows (row r ->
+                                               # [r // 128, r % 128])
 
         def wait_left(m):
             sl = jax.lax.rem(m, NB)
@@ -175,27 +197,36 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 scratch_ref.at[pl.ds(pl.multiple_of(m * TS, _ALIGN), TS)],
                 sem_fr.at[sl]).wait()
 
-        def chunk_body(c, carry):
-            fillL, fillR, nfL, nfR, wdL, wdR = carry
-            slot = jax.lax.rem(c, 2)
+        # ---- software pipeline (round 6) ----
+        # The round-5 kernel ran A -> B -> totals-DMA-wait -> C per chunk:
+        # the VMEM->SMEM totals round-trip and the flush-ring semaphore
+        # waits sat on the critical path every chunk (PERF.md measured the
+        # residual phase-A cost at ~10x its isolated compute replica — all
+        # scheduling).  Now phases A/B of chunk c run while chunk c-1's
+        # totals DMA is still in flight; phase C (scalar blends + flushes)
+        # trails ONE CHUNK behind, reading double-banked totals (SMEM) and
+        # placement tiles (comp_buf).  Phase B no longer needs the scalar
+        # fill counters — the cumulative placed-row counts ride the A/B
+        # stage as lane-resident [1, 1] vectors (cumLv/cumRv), bit-equal to
+        # the SMEM-derived scalars phase C still uses for DMA offsets.
+        def chunk_ab(c, cum):
+            cumLv, cumRv = cum
+            slot = jax.lax.rem(c, NIN)
             pltpu.make_async_copy(
                 rows_ref.at[pl.ds(pl.multiple_of(wb_al + c * CHUNK, _ALIGN),
                                   CHUNK)],
                 inbuf.at[slot], sem_in.at[slot]).wait()
 
-            @pl.when(c + 1 < nchunks)
+            @pl.when(c + NIN - 1 < nchunks)
             def _prefetch():
-                nxt = 1 - slot
+                nxt = jax.lax.rem(c + NIN - 1, NIN)
                 pltpu.make_async_copy(
                     rows_ref.at[pl.ds(
-                        pl.multiple_of(wb_al + (c + 1) * CHUNK, _ALIGN),
-                        CHUNK)],
+                        pl.multiple_of(wb_al + (c + NIN - 1) * CHUNK,
+                                       _ALIGN), CHUNK)],
                     inbuf.at[nxt], sem_in.at[nxt]).start()
 
             abs0 = wb_al + c * CHUNK
-            nsub = CHUNK // T
-            npk = CHUNK // _LANE               # lane-packed rows (row r ->
-                                               # [r // 128, r % 128])
             # ---- phase A (vector): convert, route, per-subtile prefixes.
             # EVERY per-row intermediate lives LANE-PACKED as [CHUNK/128, 128]
             # — [CHUNK, 1]-shaped vectors are 128x vreg-padded on v5e and made
@@ -264,10 +295,11 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             else:
                 S_L = selL_p.reshape(nsub, T)
                 S_R = selR_p.reshape(nsub, T)
+            bank = jax.lax.rem(c, 2)
             if "prefix" in dbg_skip:           # profiling: no prefix/totals
                 pfxU = jnp.zeros((2 * nsub, T), jnp.int32)
                 excl_col = jnp.zeros((2 * nsub, 1), jnp.float32)
-                cpt = None
+                incl_col = jnp.zeros((2 * nsub, 1), jnp.float32)
             else:
                 S = jnp.concatenate([S_L, S_R], axis=0).astype(jnp.int8)
                 pfxU = jax.lax.dot_general(
@@ -287,20 +319,29 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)      # [2*nsub, 1]
                 excl_col = incl_col - tot_col
-                if "totals" in dbg_skip:       # profiling: no totals DMA
-                    cpt = None
-                else:
-                    totals_vm[0:2 * nsub, 0:1] = tot_col.astype(jnp.int32)
-                    totals_vm[0:2 * nsub, 1:2] = incl_col.astype(jnp.int32)
-                    cpt = pltpu.make_async_copy(totals_vm, totals_sm,
-                                                sem_tot)
-                    cpt.start()
+                if totals_on:
+                    # the bank's previous DMA (chunk c - 2) was awaited by
+                    # phase C(c - 2), which ran during chunk c - 1's body —
+                    # the banked write below never races an in-flight copy.
+                    # Phase C(c) awaits this DMA only after chunk c + 1's
+                    # whole phase A/B, so the round-trip is off the
+                    # critical path instead of a per-chunk stall.
+                    totals_vm[bank, 0:2 * nsub, 0:1] = tot_col.astype(
+                        jnp.int32)
+                    totals_vm[bank, 0:2 * nsub, 1:2] = incl_col.astype(
+                        jnp.int32)
+                    pltpu.make_async_copy(totals_vm.at[bank],
+                                          totals_sm.at[bank],
+                                          sem_tot.at[bank]).start()
 
-            # ---- phase B (vector, overlaps the totals DMA): place every
-            # subtile into comp_buf.  The placement one-hot is built
-            # TRANSPOSED — dest as a [1, T] lane vector against a [2TS, 1]
-            # iota — so the dest math is lane-packed too; the [2TS, T] @
-            # [T, W] dot then lands rows directly in staging order.
+            # ---- phase B (vector, back-to-back with phase A — the totals
+            # DMA and the previous chunk's phase C overlap it): place every
+            # subtile into this chunk's comp_buf bank.  The placement
+            # one-hot is built TRANSPOSED — dest as a [1, T] lane vector
+            # against a [2TS, 1] iota — so the dest math is lane-packed
+            # too; the [2TS, T] @ [T, W] dot then lands rows directly in
+            # staging order.  The cross-chunk fill counters enter as the
+            # lane-resident cumLv/cumRv (phase B no longer reads SMEM).
             for s in range(nsub) if "phaseB" not in dbg_skip else []:
                 selLs = S_L[s:s + 1, :]                      # [1, T] i32
                 selRs = S_R[s:s + 1, :]
@@ -308,8 +349,8 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 pfxRs = pfxU[nsub + s:nsub + s + 1, :]
                 bL = excl_col[s:s + 1, 0:1].astype(jnp.int32)
                 bR = excl_col[nsub + s:nsub + s + 1, 0:1].astype(jnp.int32)
-                destL = jax.lax.rem(headL + fillL + bL + pfxLs - 1, TS)
-                destR = TS + jax.lax.rem(fillR + bR + pfxRs - 1, TS)
+                destL = jax.lax.rem(headL + cumLv + bL + pfxLs - 1, TS)
+                destR = TS + jax.lax.rem(cumRv + bR + pfxRs - 1, TS)
                 dest = jnp.where(selLs == 1, destL,
                                  jnp.where(selRs == 1, destR, 2 * TS))
                 Pt = (dest == iota2ts1).astype(jnp.int8)         # [2TS, T]
@@ -317,16 +358,31 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                     Pt, ti_i8[s * T:(s + 1) * T, :],
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.int32)            # [2TS, W]
-                comp_buf[s * 2 * TS:(s + 1) * 2 * TS, :] = (
+                comp_buf[bank, s * 2 * TS:(s + 1) * 2 * TS, :] = (
                     comp_i & 255).astype(jnp.uint8)
 
-            # ---- phase C (scalar-cheap): blends + flushes from SMEM totals
-            if cpt is None:                    # "prefix" knockout (profiling)
+            # per-side chunk totals ride the carry as [1, 1] vectors (exact:
+            # counts <= CHUNK << 2^24, and the bf16 operands of the incl dot
+            # are exact 0/1 and <= 128 values)
+            totL = incl_col[nsub - 1:nsub, 0:1].astype(jnp.int32)
+            totR = incl_col[2 * nsub - 1:2 * nsub, 0:1].astype(jnp.int32)
+            return cumLv + totL, cumRv + totR
+
+        def chunk_c(c, cc):
+            # phase C for chunk c (scalar blends + flushes), running ONE
+            # CHUNK behind phase A/B: by now the banked totals DMA has had a
+            # full chunk of matmuls to land, so the wait below is free in
+            # steady state.
+            fillL, fillR, nfL, nfR, wdL, wdR = cc
+            bank = jax.lax.rem(c, 2)
+            if totals_on:
+                pltpu.make_async_copy(totals_vm.at[bank],
+                                      totals_sm.at[bank],
+                                      sem_tot.at[bank]).wait()
+                accL = fillL + totals_sm[bank, nsub - 1, 1]
+                accR = fillR + totals_sm[bank, 2 * nsub - 1, 1]
+            else:                              # "prefix"/"totals" knockouts
                 accL, accR = fillL, fillR
-            else:
-                cpt.wait()
-                accL = fillL + totals_sm[nsub - 1, 1]
-                accR = fillR + totals_sm[2 * nsub - 1, 1]
             k1L = (headL + accL) // TS       # stream tiles complete after c
             k1R = accR // TS
 
@@ -340,12 +396,12 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                     lambda m, w: (wait_right(m), w + 1)[1], wdR)
 
             for s in range(nsub) if "phaseC" not in dbg_skip else []:
-                compL = comp_buf[s * 2 * TS:s * 2 * TS + TS, :]
-                compR = comp_buf[s * 2 * TS + TS:(s + 1) * 2 * TS, :]
-                nls = totals_sm[s, 0]
-                nrs = totals_sm[nsub + s, 0]
-                baseL = fillL + totals_sm[s, 1] - nls
-                baseR = fillR + totals_sm[nsub + s, 1] - nrs
+                compL = comp_buf[bank, s * 2 * TS:s * 2 * TS + TS, :]
+                compR = comp_buf[bank, s * 2 * TS + TS:(s + 1) * 2 * TS, :]
+                nls = totals_sm[bank, s, 0]
+                nrs = totals_sm[bank, nsub + s, 0]
+                baseL = fillL + totals_sm[bank, s, 1] - nls
+                baseR = fillR + totals_sm[bank, nsub + s, 1] - nrs
                 startL = jax.lax.rem(headL + baseL, TS)
                 startR = jax.lax.rem(baseR, TS)
                 curL = jax.lax.rem((headL + baseL) // TS, NB)
@@ -399,8 +455,24 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             return accL, accR, k1L, k1R, wdL, wdR
 
         zero = jnp.int32(0)
+        zv = jnp.zeros((1, 1), jnp.int32)
+
+        def pipe_body(c, carry):
+            # steady state: A/B of chunk c overlaps the in-flight totals DMA
+            # of chunk c - 1, whose phase C runs right after (the inner
+            # fori_loop has exactly one trip for c >= 1 and zero for c = 0)
+            cumLv, cumRv, fillL, fillR, nfL, nfR, wdL, wdR = carry
+            cumLv, cumRv = chunk_ab(c, (cumLv, cumRv))
+            cc = jax.lax.fori_loop(jnp.maximum(c - 1, 0), c, chunk_c,
+                                   (fillL, fillR, nfL, nfR, wdL, wdR))
+            return (cumLv, cumRv) + cc
+
+        carry = jax.lax.fori_loop(
+            0, nchunks, pipe_body,
+            (zv, zv, zero, zero, zero, zero, zero, zero))
+        # pipeline epilogue: the last chunk's phase C
         fillL, fillR, nfL, nfR, wdL, wdR = jax.lax.fori_loop(
-            0, nchunks, chunk_body, (zero, zero, zero, zero, zero, zero))
+            jnp.maximum(nchunks - 1, 0), nchunks, chunk_c, carry[2:])
         nl = fillL
         nr = fillR
         stats_ref[0, 0] = nl
@@ -446,7 +518,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         # ---- smaller child's histogram from its CONTIGUOUS block ----
         # Post-partition the smaller child is contiguous (left block in
         # rows_ref, right block in scratch).  With the factored hi/lo build
-        # (histogram._accum_factored_T) the per-row cost is nhi + nlo
+        # (histogram._accum_factored_group) the per-row cost is nhi + nlo
         # compares per feature instead of B — near-independent of max_bin —
         # and the outer product rides the MXU contraction; wide-F datasets
         # fall back to the classic packed one-hot tiles.
@@ -462,31 +534,37 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             def hist_pass(src_ref, base_al, head, cnt):
                 nh = (head + cnt + CHUNK - 1) // CHUNK
 
-                @pl.when(nh > 0)
-                def _pro():
-                    pltpu.make_async_copy(
-                        src_ref.at[pl.ds(base_al, CHUNK)], inbuf.at[0],
-                        sem_in.at[0]).start()
+                for j in range(NIN - 1):
+                    @pl.when(j < nh)
+                    def _pro(j=j):
+                        pltpu.make_async_copy(
+                            src_ref.at[pl.ds(
+                                pl.multiple_of(base_al + j * CHUNK, _ALIGN),
+                                CHUNK)],
+                            inbuf.at[j], sem_in.at[j]).start()
 
                 def hbody(c, _):
-                    slot = jax.lax.rem(c, 2)
+                    slot = jax.lax.rem(c, NIN)
                     pltpu.make_async_copy(
                         src_ref.at[pl.ds(
                             pl.multiple_of(base_al + c * CHUNK, _ALIGN),
                             CHUNK)],
                         inbuf.at[slot], sem_in.at[slot]).wait()
 
-                    @pl.when(c + 1 < nh)
+                    @pl.when(c + NIN - 1 < nh)
                     def _pre():
-                        nxt = 1 - slot
+                        nxt = jax.lax.rem(c + NIN - 1, NIN)
                         pltpu.make_async_copy(
                             src_ref.at[pl.ds(
-                                pl.multiple_of(base_al + (c + 1) * CHUNK,
-                                               _ALIGN), CHUNK)],
+                                pl.multiple_of(base_al + (c + NIN - 1)
+                                               * CHUNK, _ALIGN), CHUNK)],
                             inbuf.at[nxt], sem_in.at[nxt]).start()
 
                     ti_c = inbuf[slot].astype(jnp.int32)
                     if factored:
+                        # rolled fori_loop over feature groups (round 6):
+                        # program size is O(p) in F, so wide-F row stores
+                        # compile instead of unrolling hundreds of groups
                         ti_bf_h = ti_c.astype(jnp.bfloat16)
                         posT = (c * CHUNK + jax.lax.broadcasted_iota(
                             jnp.int32, (1, CHUNK), 1))
@@ -494,13 +572,12 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                                 * (posT < head + cnt).astype(jnp.float32))
                         fb = (scal_ref[12 + num_bins // 32] if f_shard
                               else 0)
-                        colT_fn, v4T = _extract_T(
-                            ti_bf_h, num_features=num_features, voff=voff,
-                            bpc=bpc, packed=packed, exact=exact, inwT=inwT,
-                            f_base=fb)
-                        _accum_factored_T(colT_fn, v4T, hist_ref,
-                                          num_features=num_features,
-                                          num_bins=num_bins)
+                        v4T = _extract_values_T(ti_bf_h, voff=voff,
+                                                exact=exact, inwT=inwT)
+                        _accum_factored_all(ti_bf_h, v4T, hist_ref,
+                                            num_features=num_features,
+                                            num_bins=num_bins, bpc=bpc,
+                                            packed=packed, f_base=fb)
                         return 0
                     ext_h = jax.lax.dot_general(
                         ti_c.astype(jnp.bfloat16), wmat_h,
@@ -516,19 +593,13 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                            * (pos < head + cnt).astype(jnp.float32))
                     vals = jnp.concatenate([g * inw, h * inw], axis=1)
                     v4 = _hilo_split(vals, axis=1, exact=exact)
-
-                    def colf(f):
-                        if packed:
-                            return (ti_c[:, f // 2:f // 2 + 1]
-                                    >> (4 * (f % 2))) & 15
-                        if bpc == 2:
-                            return (ti_c[:, 2 * f:2 * f + 1]
-                                    | (ti_c[:, 2 * f + 1:2 * f + 2] << 8))
-                        return ti_c[:, f:f + 1]
-
-                    _accum_onehot_tiles(colf, v4, hist_ref,
-                                        num_features=num_features,
-                                        num_bins=num_bins, contract_dim=0)
+                    # classic fallback (accumulators past the factored 4 MiB
+                    # gate, i.e. wide F): rolled fori_loop over lane tiles
+                    # with dynamic-index column extraction
+                    colf = _colf_rows_dyn(ti_c, bpc=bpc, packed=packed)
+                    _accum_onehot_all(colf, v4, hist_ref,
+                                      num_features=num_features,
+                                      num_bins=num_bins, contract_dim=0)
                     return 0
 
                 jax.lax.fori_loop(0, nh, hbody, 0)
@@ -718,20 +789,21 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
                 pl.BlockSpec(memory_space=pltpu.SMEM),   # nl
             ],
             scratch_shapes=[
-                pltpu.VMEM((2, CHUNK, W), jnp.uint8),    # streamed chunks
+                pltpu.VMEM((NIN, CHUNK, W), jnp.uint8),  # streamed chunk ring
                 pltpu.VMEM((2 * NB, TS, W), jnp.uint8),  # L/R flush rings
                 pltpu.VMEM((T, T), jnp.int8),            # upper-tri prefix ones
                 pltpu.VMEM((TS, TS), jnp.int8),          # copy-back rotation
                 pltpu.VMEM((2, TS, W), jnp.uint8),       # RMW/cb-read bounce
-                pltpu.VMEM((2 * TS * (CHUNK // T), W), jnp.uint8),  # placed
-                pltpu.VMEM((128, 2), jnp.int32),         # subtile totals
-                pltpu.SMEM((128, 2), jnp.int32),         # totals landing
-                pltpu.SemaphoreType.DMA((2,)),           # chunk/cb reads
+                pltpu.VMEM((2, 2 * TS * (CHUNK // T), W),
+                           jnp.uint8),                   # placed, 2 banks
+                pltpu.VMEM((2, 128, 2), jnp.int32),      # subtile totals banks
+                pltpu.SMEM((2, 128, 2), jnp.int32),      # totals landing banks
+                pltpu.SemaphoreType.DMA((NIN,)),         # chunk/cb reads
                 pltpu.SemaphoreType.DMA,                 # prefills + finals
                 pltpu.SemaphoreType.DMA((NB,)),          # left flush ring
                 pltpu.SemaphoreType.DMA((NB,)),          # right flush ring
                 pltpu.SemaphoreType.DMA((NB,)),          # copy-back ring
-                pltpu.SemaphoreType.DMA,                 # totals VMEM->SMEM
+                pltpu.SemaphoreType.DMA((2,)),           # totals banks
             ],
         ),
         out_shape=[

@@ -53,6 +53,15 @@ class SplitParams(NamedTuple):
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
     min_data_per_group: int = 100
+    # extremely-randomized trees (config.h:318): numerical features consider
+    # ONE random threshold per (feature, leaf) instead of scanning every bin
+    extra_trees: bool = False
+    extra_seed: int = 6
+    # per-feature split-gain scaling, inner-feature order (config.h:432-436:
+    # gain[i] = max(0, feature_contri[i]) * gain[i]); () == disabled.  A
+    # tuple so SplitParams stays hashable/static; learners index it by
+    # GLOBAL inner feature id (see tree_learner's _apply_contri)
+    feature_contri: tuple = ()
 
 
 class FeatureInfo(NamedTuple):
@@ -104,6 +113,42 @@ class FeatureBest(NamedTuple):
     left_output: jax.Array
     right_output: jax.Array
     cat_bitset: jax.Array    # [F, B//32] u32
+
+
+def _avalanche_u32(x):
+    """xxhash-style integer avalanche (the same mixer as gbdt._bag_uniforms,
+    kept local to avoid a core -> boosting import)."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(2246822519)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(3266489917)
+    return x ^ (x >> 16)
+
+
+def _extra_trees_mask(feat: FeatureInfo, sum_grad, sum_hess, t,
+                      params: SplitParams):
+    """One random candidate threshold per (feature, leaf) — the reference's
+    ``rand_threshold_gen_`` draw under ``extra_trees`` (config.h:318,
+    feature_histogram.hpp use_rand_threshold).  The draw is a stateless hash
+    of (extra_seed, feature index, leaf-total bits), so it is deterministic
+    for a given dataset/seed yet varies across leaves and trees — a
+    sequential RNG stream would not survive the vmapped per-leaf scan or
+    the fused multi-iteration lax.scan."""
+    f32 = jnp.float32
+    salt = (jax.lax.bitcast_convert_type(
+        sum_grad.astype(f32), jnp.int32).astype(jnp.uint32)
+        ^ (jax.lax.bitcast_convert_type(
+            sum_hess.astype(f32), jnp.int32).astype(jnp.uint32) << 1))
+    F = feat.num_bin.shape[0]
+    fid = jnp.arange(F, dtype=jnp.uint32)
+    x = fid * jnp.uint32(2654435761)
+    x = x ^ (salt + jnp.uint32(params.extra_seed & 0xFFFFFFFF)
+             * jnp.uint32(0x9E3779B9))
+    x = _avalanche_u32(x)
+    # thresholds live in [0, nb - 2] (bin <= t goes left)
+    ncand = jnp.maximum(feat.num_bin - 1, 1).astype(jnp.uint32)
+    rbin = jax.lax.rem(x, ncand).astype(jnp.int32)
+    return t == rbin[:, None]
 
 
 def threshold_l1(s, l1):
@@ -236,6 +281,12 @@ def per_feature_best(hist: jax.Array, feat: FeatureInfo, feature_mask: jax.Array
     if threshold_mask is not None:
         valid0 = valid0 & threshold_mask[None, :]
         valid1 = valid1 & threshold_mask[None, :]
+    elif params.extra_trees:
+        # forced splits (threshold_mask) bypass the randomization, matching
+        # the reference's GatherInfoForThreshold
+        et_mask = _extra_trees_mask(feat, sum_grad, sum_hess, t, params)
+        valid0 = valid0 & et_mask
+        valid1 = valid1 & et_mask
     gain0, lo0, ro0 = evaluate(left_g0, left_h0, left_c0,
                                right_g0, right_h0, right_c0, valid0)
     gain1, lo1, ro1 = evaluate(left_g1, left_h1, left_c1,

@@ -431,6 +431,19 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                         tiled=True)
         return jax.lax.psum(h, axis_name)
 
+    contri = (jnp.maximum(jnp.asarray(params.feature_contri, f32), 0.0)
+              if params.feature_contri else None)
+
+    def _apply_contri(fb, ids):
+        """gain[i] = max(0, feature_contri[i]) * gain[i] (config.h:432-436),
+        applied before the cross-feature argmax (and before CEGB's penalty
+        subtraction); ``ids`` maps the scan's positions to global inner
+        feature indices so sharded/elected scans index the full vector."""
+        if contri is None:
+            return fb
+        return fb._replace(gain=jnp.where(
+            fb.gain > K_MIN_SCORE, fb.gain * contri[ids], fb.gain))
+
     def best_of(h, sg, sh, cnt, cmn, cmx, used=None, ucnt=None):
         """Best split of a leaf; with CEGB also returns the per-feature
         candidates (the reference's splits_per_leaf_ cache,
@@ -444,6 +457,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 any_categorical=has_categorical,
                 cmin=cmn if has_monotone else None,
                 cmax=cmx if has_monotone else None)
+            fb = _apply_contri(fb, ids_c)
             return sync_best(reduce_feature_best(fb, ids_c), axis_name)
         if vote_mode:
             # per-shard candidate search on LOCAL histograms with scaled
@@ -456,6 +470,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 any_categorical=has_categorical,
                 cmin=cmn if has_monotone else None,
                 cmax=cmx if has_monotone else None)
+            fb_local = _apply_contri(fb_local, jnp.arange(f, dtype=jnp.int32))
             kk = min(top_k, f)
             top_gain, top_ids = jax.lax.top_k(fb_local.gain, kk)
             all_ids = jax.lax.all_gather(top_ids, axis_name).reshape(-1)
@@ -474,12 +489,13 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 any_categorical=has_categorical,
                 cmin=cmn if has_monotone else None,
                 cmax=cmx if has_monotone else None)
-            return reduce_feature_best(fb, elected)
+            return reduce_feature_best(_apply_contri(fb, elected), elected)
         fb = per_feature_best_combined(
             unpack(h, sg, sh), feat, feature_mask, sg, sh, cnt, params,
             any_categorical=has_categorical,
             cmin=cmn if has_monotone else None,
             cmax=cmx if has_monotone else None)
+        fb = _apply_contri(fb, jnp.arange(f, dtype=jnp.int32))
         if cegb is not None:
             # DetlaGain (cost_effective_gradient_boosting.hpp:50-61):
             # split penalty + coupled (until first use) + lazy on-demand
@@ -1173,7 +1189,10 @@ class SerialTreeLearner:
             cat_l2=float(config.cat_l2),
             cat_smooth=float(config.cat_smooth),
             max_cat_threshold=int(config.max_cat_threshold),
-            min_data_per_group=int(config.min_data_per_group))
+            min_data_per_group=int(config.min_data_per_group),
+            extra_trees=bool(config.extra_trees),
+            extra_seed=int(config.extra_seed),
+            feature_contri=self._map_feature_contri(config, dataset))
         self.has_categorical = bool(dataset.feature_is_categorical().any())
         mono_cfg = list(getattr(config, "monotone_constraints", []) or [])
         mono = np.zeros(dataset.num_features, dtype=np.int32)
@@ -1256,6 +1275,19 @@ class SerialTreeLearner:
             self.cegb_paid = jnp.zeros(
                 (self.num_data + self.padded_rows,
                  -(-dataset.num_features // 8)), jnp.uint8)
+
+    @staticmethod
+    def _map_feature_contri(config, dataset) -> tuple:
+        """config.feature_contri (ORIGINAL feature order, config.h:432-436)
+        -> per-used-inner-feature tuple; () when the param is unset."""
+        contri = list(getattr(config, "feature_contri", []) or [])
+        if not contri:
+            return ()
+        out = [1.0] * dataset.num_features
+        for j, orig in enumerate(dataset.used_feature_idx):
+            if orig < len(contri):
+                out[j] = float(contri[orig])
+        return tuple(out)
 
     def _load_forced_splits(self, config, dataset):
         """BFS schedule from forcedsplits_filename

@@ -138,22 +138,76 @@ class AucMuMetric(Metric):
                 cum_j = np.cumsum(~seg_i)
                 total += float(np.sum(j_before[s] + 0.5 * cum_j[seg_i]))
                 continue
-            num_j = 0.0
-            last_j = None
-            num_cur = 0.0
-            for t in range(s, e):
-                if ii[t]:
-                    if last_j is not None and abs(d[t] - last_j) < k_eps:
-                        total += j_before[s] + num_j - 0.5 * num_cur
+            if e - s < 64:
+                # numpy setup costs more than it saves on tiny clusters
+                num_j = 0.0
+                last_j = None
+                num_cur = 0.0
+                for t in range(s, e):
+                    if ii[t]:
+                        if last_j is not None and abs(d[t] - last_j) < k_eps:
+                            total += j_before[s] + num_j - 0.5 * num_cur
+                        else:
+                            total += j_before[s] + num_j
                     else:
-                        total += j_before[s] + num_j
-                else:
-                    num_j += 1.0
-                    if last_j is not None and abs(d[t] - last_j) < k_eps:
-                        num_cur += 1.0
-                    else:
-                        last_j = d[t]
-                        num_cur = 1.0
+                        num_j += 1.0
+                        if last_j is not None and abs(d[t] - last_j) < k_eps:
+                            num_cur += 1.0
+                        else:
+                            last_j = d[t]
+                            num_cur = 1.0
+                continue
+            # Anchored sweep, vectorized (the per-element Python loop above
+            # was O(n) interpreted work per class pair per eval round and
+            # dominated eval on epsilon-chained score clusters).  The only
+            # sequential structure is the j-run ANCHOR chain — a new run
+            # starts at the first j whose distance is >= kEpsilon past the
+            # current anchor — found by a searchsorted chase over the
+            # (sorted) j distances, O(#runs * log n); all per-element
+            # credits then assign in one shot.
+            segd = d[s:e]
+            segi = ii[s:e]
+            jd = segd[~segi]                       # j distances, ascending
+            excl_j = np.concatenate([[0.0], np.cumsum(~segi)])[:-1]
+            if jd.size == 0:
+                total += float(np.sum(segi)) * j_before[s]
+                continue
+            run_starts = []                        # index into jd
+            nj = jd.size
+            a = 0
+            while a < nj:
+                run_starts.append(a)
+                # difference form, NOT searchsorted(jd, jd[a] + k_eps): at
+                # |d| >> k_eps the addition absorbs the epsilon entirely,
+                # while the loop this replaces compared d[t] - last_j.
+                # Galloping window: a full-tail slice per run is quadratic
+                # on long anchor chains.
+                base = jd[a]
+                lo = a + 1
+                step = 32
+                hi = min(lo + step, nj)
+                while hi < nj and jd[hi - 1] - base < k_eps:
+                    lo = hi
+                    step *= 2
+                    hi = min(lo + step, nj)
+                a = lo + int(np.searchsorted(jd[lo:hi] - base, k_eps,
+                                             side="left"))
+            run_starts = np.asarray(run_starts)
+            rid_of_j = np.searchsorted(run_starts,
+                                       np.arange(jd.size), side="right") - 1
+            anchors = jd[run_starts]
+            # per position: index of the last j strictly before it (into jd)
+            jn = excl_j.astype(np.int64) - 1
+            has_j = (jn >= 0) & segi
+            jn_c = np.maximum(jn, 0)
+            rid = rid_of_j[jn_c]
+            # "within kEpsilon of the current run's anchor" — exactly the
+            # reference comparison against last_j_dist (:258-280)
+            within = has_j & (np.abs(segd - anchors[rid]) < k_eps)
+            num_cur = (jn_c + 1 - run_starts[rid]).astype(np.float64)
+            credit = j_before[s] + excl_j - np.where(within, 0.5 * num_cur,
+                                                     0.0)
+            total += float(np.sum(credit[segi]))
         return total / (n_i * n_j)
 
     def eval(self, score, objective=None):

@@ -35,6 +35,18 @@ from ..core.tree_learner import (Comm, SerialTreeLearner, TreeArrays,
                                  build_tree_partitioned)
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """shard_map across jax versions: the public ``jax.shard_map`` alias
+    (with ``check_vma``) landed after 0.4.x; older jax exposes
+    ``jax.experimental.shard_map`` with ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def default_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     """1-D mesh over the first ``num_devices`` local devices (all by default)."""
     devices = jax.devices()
@@ -132,10 +144,10 @@ class _ParallelTreeLearner(SerialTreeLearner):
         bins_spec = P() if self.mode == "feature" else P(self.axis, None)
         out_specs = TreeArrays(
             *([P()] * len(TreeArrays._fields)))._replace(row_leaf=row)
-        shard_fn = jax.shard_map(
+        shard_fn = _shard_map(
             fn, mesh=self.mesh,
             in_specs=(bins_spec, row, row, P(), P(), P()),
-            out_specs=out_specs, check_vma=False)
+            out_specs=out_specs)
         return jax.jit(shard_fn)
 
     def _prep_train(self, grad, hess, feature_mask):
@@ -212,11 +224,11 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
         if lazy:
             out_specs = (out_specs, P(self.axis, None))
         paid_spec = P(self.axis, None) if lazy else P()
-        shard_fn = jax.shard_map(
+        shard_fn = _shard_map(
             fn, mesh=self.mesh,
             in_specs=(P(self.axis, None), row, row, P(), P(), P(), P(),
                       paid_spec),
-            out_specs=out_specs, check_vma=False)
+            out_specs=out_specs)
         return jax.jit(shard_fn)
 
     def train(self, grad, hess, num_data_in_bag, feature_mask=None):

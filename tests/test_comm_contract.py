@@ -117,42 +117,27 @@ def test_voting_elected_psum_payload():
     assert not full, f"voting must NOT allreduce the full block: {full}"
 
 
-def test_data_parallel_measured_scaling_band():
-    """One MEASURED scaling number (VERDICT r4 #8): fixed TOTAL rows, d=1 vs
-    d=8 on the single-core virtual mesh.  With rows sharded correctly, total
-    row work is constant in d, so wall time must stay within a generous
-    band; if every shard accidentally processed ALL rows (gross
-    serialization — the failure this guards), d=8 would cost ~8x d=1.
-    (True per-device weak scaling needs real chips; the ICI-volume side is
-    pinned structurally above.)"""
-    import time
-
-    times = {}
-    for d in (1, 8):
-        rng = np.random.RandomState(0)
-        n = 64 * 1024
-        X = rng.normal(size=(n, F))
-        y = X[:, 0] + rng.normal(scale=0.1, size=n)
-        ds = BinnedDataset.from_matrix(X, label=y, max_bin=15)
-        cfg = Config(num_leaves=16, min_data_in_leaf=2)
-        learner = DataParallelTreeLearner(ds, cfg, mesh=default_mesh(d))
-        grad = learner.pad_rows(jnp.asarray(-(y - y.mean()),
-                                            dtype=jnp.float32))
-        hess = learner.pad_rows(jnp.ones((n,), dtype=jnp.float32))
-        arr = learner.train(grad, hess, n)
-        jax.block_until_ready(arr.leaf_value)         # compile + warm
-        best = float("inf")
-        for _ in range(3):                # best-of-3: robust to load spikes
-            t0 = time.perf_counter()
-            arr = learner.train(grad, hess, n)
-            jax.block_until_ready(arr.leaf_value)
-            best = min(best, time.perf_counter() - t0)
-        times[d] = best
-        assert int(arr.num_leaves) == 16
-    ratio = times[8] / times[1]
-    assert ratio < 4.0, (
-        f"d=8 took {ratio:.1f}x d=1 at fixed total rows "
-        f"({times}) — shards appear to duplicate row work")
+def test_data_parallel_per_shard_row_work_exact():
+    """EXACT per-shard row-work pin (replaces the round-5 wall-clock band,
+    which passed anything under a loose 4.0x and was hostage to load
+    spikes): at fixed TOTAL rows, the lowered program's per-shard row-store
+    buffer must hold exactly n/d rows for every mesh size — row work
+    perfectly partitioned, no duplication, no hidden replication.  A shard
+    accidentally processing ALL rows (the gross-serialization failure the
+    old band guarded against) shows up here as n instead of n/d, and even a
+    single duplicated CHUNK would shift the shape."""
+    n = 64 * 1024
+    rows = {}
+    for d in (1, 2, 8):
+        txt, learner = _lowered_text(n=n, d=d, num_leaves=16)
+        assert learner.padded_rows == 0, (
+            "n divisible by every d keeps the pin exact; padding would "
+            "blur it")
+        m = re.findall(r"tensor<(\d+)x128xui8>", txt)
+        assert m, "row store not found in lowered text"
+        rows[d] = max(int(x) for x in m)
+    assert rows == {1: n, 2: n // 2, 8: n // 8}, (
+        f"per-shard row stores must be exactly n/d: {rows}")
 
 
 def test_feature_parallel_histogram_state_is_sharded():

@@ -108,15 +108,73 @@ def test_histogram_rows_classic_fallback(monkeypatch):
     past the factored path's 4 MiB VMEM bound, e.g. F > 1024 at B=64)."""
     import lightgbm_tpu.core.histogram as H
     monkeypatch.setattr(H, "_use_factored", lambda f, b: False)
-    n, f, b = 2048, 9, 64
-    rows, voff = make_rows_store(n, f, b, seed=1)
-    got = np.asarray(H.histogram_pallas_rows(
-        jnp.asarray(rows), b, jnp.int32(100), jnp.int32(1500),
+    for f, b, bpc, packed in ((9, 64, 1, False), (5, 512, 2, False),
+                              (7, 32, 1, True)):
+        n = 2048
+        rows, voff = make_rows_store(n, f, b, seed=1, bpc=bpc, packed=packed,
+                                     W=128 if bpc == 1 else 256)
+        got = np.asarray(H.histogram_pallas_rows(
+            jnp.asarray(rows), b, jnp.int32(100), jnp.int32(1500),
+            num_features=f, voff=voff, bpc=bpc, packed=packed,
+            row_tile=1024, interpret=True))
+        bins, values = rows_split_xla(jnp.asarray(rows), f, voff, bpc,
+                                      packed)
+        want = np.asarray(histogram_xla_masked(
+            bins, values, b, jnp.int32(100), jnp.int32(1500)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"f={f} b={b} bpc={bpc}")
+
+
+def test_histogram_rows_wide_f_factored_grid():
+    """Grid-over-groups at Bosch width (F=968, 63-bin setting): the round-5
+    layout unrolled 242 feature groups into the program and could not
+    compile at this width; the grid layout keeps program size O(p) and this
+    test pins its numerics (interpret mode)."""
+    n, f, b = 1024, 968, 64
+    rows, voff = make_rows_store(n, f, b, seed=5, W=1152)
+    assert _use_factored(f, b)
+    got = np.asarray(histogram_pallas_rows(
+        jnp.asarray(rows), b, jnp.int32(100), jnp.int32(800),
         num_features=f, voff=voff, row_tile=1024, interpret=True))
     bins, values = rows_split_xla(jnp.asarray(rows), f, voff, 1, False)
     want = np.asarray(histogram_xla_masked(
-        bins, values, b, jnp.int32(100), jnp.int32(1500)))
+        bins, values, b, jnp.int32(100), jnp.int32(800)))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_rows_wide_f_classic_grid():
+    """Wide F x 256 bins exceeds the factored accumulator's 4 MiB gate and
+    takes the classic packed-tile path — now a grid over lane tiles with
+    dynamic-index extraction (the unrolled version was the other
+    multi-10-minute compile)."""
+    n, f, b = 1024, 600, 256
+    rows, voff = make_rows_store(n, f, b, seed=6, W=768)
+    assert not _use_factored(f, b)
+    got = np.asarray(histogram_pallas_rows(
+        jnp.asarray(rows), b, jnp.int32(50), jnp.int32(900),
+        num_features=f, voff=voff, row_tile=1024, interpret=True))
+    bins, values = rows_split_xla(jnp.asarray(rows), f, voff, 1, False)
+    want = np.asarray(histogram_xla_masked(
+        bins, values, b, jnp.int32(50), jnp.int32(900)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_rows_feature_window_matches_slice():
+    """Traced f_begin (feature-parallel shards histogram only their own F/d
+    block) against the full build's slice — the dynamic-group extraction
+    must honor the window base."""
+    n, f, b = 2048, 24, 64
+    rows, voff = make_rows_store(n, f, b, seed=8)
+    full = np.asarray(histogram_pallas_rows(
+        jnp.asarray(rows), b, jnp.int32(300), jnp.int32(1500),
+        num_features=f, voff=voff, row_tile=1024, interpret=True))
+    for f0, fc in ((0, 12), (12, 12), (8, 8)):
+        win = np.asarray(histogram_pallas_rows(
+            jnp.asarray(rows), b, jnp.int32(300), jnp.int32(1500),
+            num_features=fc, voff=voff, row_tile=1024, interpret=True,
+            f_begin=jnp.int32(f0)))
+        np.testing.assert_allclose(win, full[f0:f0 + fc], rtol=1e-4,
+                                   atol=1e-4)
 
 
 def test_histogram_masked_rows_contribute_nothing():

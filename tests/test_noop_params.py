@@ -1,0 +1,171 @@
+"""The three formerly-silent no-op params now wired to behavior (round 6):
+
+- pos/neg_bagging_fraction balanced bagging (config.h:261-281)
+- extra_trees randomized thresholds (config.h:318)
+- feature_contri per-feature gain scaling (config.h:432-436)
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.split import (FeatureInfo, SplitParams,
+                                     per_feature_best)
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+
+
+def _binary_problem(n=4000, f=6, seed=0, pos_rate=0.5):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = X[:, 0] * 2.0 + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n)
+    thr = np.quantile(logit, 1.0 - pos_rate)
+    y = (logit > thr).astype(np.float64)
+    return X, y
+
+
+def _booster(X, y, **cfg_kwargs):
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    cfg = Config(objective="binary", num_leaves=15, num_iterations=4,
+                 learning_rate=0.2, max_bin=63, verbosity=-1, **cfg_kwargs)
+    return GBDT(cfg, ds, create_objective("binary", cfg))
+
+
+# ---- balanced bagging ----
+
+def test_neg_bagging_fraction_downsamples_only_negatives():
+    X, y = _binary_problem(pos_rate=0.3)
+    b = _booster(X, y, neg_bagging_fraction=0.3, bagging_freq=1,
+                 bagging_seed=7)
+    b._bagging(0)
+    mask = np.asarray(b.bag_mask)[:b.num_data]
+    pos_kept = mask[y > 0].mean()
+    neg_kept = mask[y <= 0].mean()
+    assert pos_kept == 1.0, "pos_bagging_fraction=1.0 must keep every positive"
+    assert 0.2 < neg_kept < 0.4, f"negatives kept at {neg_kept}, want ~0.3"
+    assert b.bag_data_cnt == int(mask.sum())
+
+
+def test_balanced_bagging_is_deterministic_and_windowed():
+    X, y = _binary_problem(pos_rate=0.4)
+    b1 = _booster(X, y, pos_bagging_fraction=0.6, neg_bagging_fraction=0.2,
+                  bagging_freq=2, bagging_seed=11)
+    b2 = _booster(X, y, pos_bagging_fraction=0.6, neg_bagging_fraction=0.2,
+                  bagging_freq=2, bagging_seed=11)
+    b1._bagging(0)
+    b2._bagging(0)
+    np.testing.assert_array_equal(np.asarray(b1.bag_mask),
+                                  np.asarray(b2.bag_mask))
+    m0 = np.asarray(b1.bag_mask).copy()
+    b1._bagging(1)   # same freq window -> mask unchanged (freq=2)
+    np.testing.assert_array_equal(np.asarray(b1.bag_mask), m0)
+    b1._bagging(2)   # new window -> new draw
+    assert not np.array_equal(np.asarray(b1.bag_mask), m0)
+
+
+def test_balanced_bagging_trains_and_disables_fusion():
+    X, y = _binary_problem()
+    b = _booster(X, y, pos_bagging_fraction=0.9, neg_bagging_fraction=0.5,
+                 bagging_freq=1)
+    assert not b._can_fuse_iters(), \
+        "per-class fractions need labels, which the fused scan cannot see"
+    for _ in range(3):
+        b.train_one_iter()
+    assert b.num_trees == 3
+    # active bagging must actually shrink the bag
+    assert b.bag_data_cnt < b.num_data
+
+
+# ---- extra_trees ----
+
+def _toy_feature_best(params, f=12, b=32, seed=0):
+    rng = np.random.RandomState(seed)
+    hist = jnp.asarray(np.abs(rng.normal(size=(f, 2, b))).astype(np.float32))
+    feat = FeatureInfo(
+        num_bin=jnp.full((f,), b, jnp.int32),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool),
+        monotone=jnp.zeros((f,), jnp.int32))
+    mask = jnp.ones((f,), bool)
+    sg = jnp.float32(float(hist[:, 0, :].sum() / f))
+    sh = jnp.float32(float(hist[:, 1, :].sum() / f))
+    return per_feature_best(hist, feat, mask, sg, sh, jnp.int32(5000),
+                            params)
+
+
+def test_extra_trees_single_random_threshold_per_feature():
+    base = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=1e-3)
+    et = base._replace(extra_trees=True, extra_seed=4)
+    fb_full = _toy_feature_best(base)
+    fb_et1 = _toy_feature_best(et)
+    fb_et2 = _toy_feature_best(et)
+    # deterministic given the seed
+    np.testing.assert_array_equal(np.asarray(fb_et1.threshold),
+                                  np.asarray(fb_et2.threshold))
+    # the randomized scan must actually restrict candidates: across 12
+    # features, at least one random threshold differs from the full scan's
+    # argmax, and no gain may EXCEED the full scan's (subset of candidates)
+    assert (np.asarray(fb_et1.threshold)
+            != np.asarray(fb_full.threshold)).any()
+    g_et = np.asarray(fb_et1.gain)
+    g_full = np.asarray(fb_full.gain)
+    found = g_et > -np.inf
+    assert (g_et[found] <= g_full[found] + 1e-4).all()
+    # a different extra_seed re-draws
+    fb_et3 = _toy_feature_best(et._replace(extra_seed=99))
+    assert (np.asarray(fb_et3.threshold)
+            != np.asarray(fb_et1.threshold)).any()
+
+
+def test_extra_trees_end_to_end_changes_model_and_trains():
+    X, y = _binary_problem()
+    b_def = _booster(X, y)
+    b_et = _booster(X, y, extra_trees=True)
+    for _ in range(3):
+        b_def.train_one_iter()
+        b_et.train_one_iter()
+    t_def = b_def.models[0]
+    t_et = b_et.models[0]
+    same = (t_def.num_leaves == t_et.num_leaves
+            and np.array_equal(t_def.threshold[:t_def.num_leaves - 1],
+                               t_et.threshold[:t_et.num_leaves - 1]))
+    assert not same, "extra_trees must randomize the chosen thresholds"
+    pred = np.asarray(b_et.predict(X, raw_score=True))
+    from lightgbm_tpu.metric.binary import weighted_auc
+    assert weighted_auc(y, pred, None) > 0.8, "extra_trees model must learn"
+
+
+# ---- feature_contri ----
+
+def test_feature_contri_zero_vetoes_dominant_feature():
+    X, y = _binary_problem()
+    b_def = _booster(X, y)
+    b_def.train_one_iter()
+    root_def = int(b_def.models[0].split_feature[0])
+    assert root_def == 0, "feature 0 carries the signal in this problem"
+    contri = [1.0] * X.shape[1]
+    contri[0] = 0.0
+    b_pen = _booster(X, y, feature_contri=contri)
+    b_pen.train_one_iter()
+    tree = b_pen.models[0]
+    used = set(int(v) for v in tree.split_feature[:tree.num_leaves - 1])
+    assert 0 not in used, \
+        "feature_contri[0]=0 must zero feature 0's gain everywhere"
+
+
+def test_feature_contri_scales_reported_gain():
+    X, y = _binary_problem()
+    b_half = _booster(X, y, feature_contri=[0.5] * X.shape[1])
+    b_def = _booster(X, y)
+    b_half.train_one_iter()
+    b_def.train_one_iter()
+    t_h, t_d = b_half.models[0], b_def.models[0]
+    # identical structure (uniform scaling preserves the argmax)...
+    np.testing.assert_array_equal(t_h.split_feature[:t_h.num_leaves - 1],
+                                  t_d.split_feature[:t_d.num_leaves - 1])
+    # ...but the recorded split gains are halved (config.h:432 semantics)
+    np.testing.assert_allclose(
+        np.asarray(t_h.split_gain[:t_h.num_leaves - 1]),
+        0.5 * np.asarray(t_d.split_gain[:t_d.num_leaves - 1]), rtol=1e-5)

@@ -136,6 +136,22 @@ def test_u16_bins_bpc2():
     run_case(wb=55, wc=2800, num_bins=512, thr=300, seed=15, bpc=2)
 
 
+def test_fused_kernel_classic_hist_fallback(monkeypatch):
+    """The fused kernel's classic (non-factored) in-kernel histogram — the
+    path wide-F x 256-bin datasets take past the 4 MiB accumulator gate —
+    now a rolled fori_loop over lane tiles with dynamic extraction."""
+    import lightgbm_tpu.core.partition as P
+    monkeypatch.setattr(P, "_use_factored", lambda f, b: False)
+    # the jit cache key does not see the monkeypatch: force retraces both
+    # entering (pick up the classic path) and leaving (restore factored)
+    P.partition_hist_pallas.clear_cache()
+    try:
+        run_case(wb=321, wc=3000, seed=16)
+        run_case(wb=100, wc=2500, thr=7, nb=16, seed=17, packed=True)
+    finally:
+        P.partition_hist_pallas.clear_cache()
+
+
 def test_sequential_splits_stay_consistent():
     """Split the root, then split each child window; windows stay coherent."""
     n_pad, f, num_bins = 3 * CHUNK, 6, 32

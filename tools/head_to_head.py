@@ -80,9 +80,10 @@ def parse_auc(log):
     return out
 
 
-def run_cli(cmd, tag):
+def run_cli(cmd, tag, env_extra=None):
     t0 = time.perf_counter()
-    p = subprocess.run(cmd, capture_output=True, text=True)
+    env = dict(os.environ, **(env_extra or {}))
+    p = subprocess.run(cmd, capture_output=True, text=True, env=env)
     dt = time.perf_counter() - t0
     log = p.stdout + p.stderr
     with open("%s/%s.log" % (WORK, tag), "w") as fh:
@@ -105,9 +106,9 @@ def main():
     threads = os.cpu_count()
 
     results = {}
-    for tag, cli, env in (
-            ("reference", [REF_CLI], {}),
-            ("lightgbm_tpu", [sys.executable, "-m", "lightgbm_tpu"], {})):
+    for tag, cli in (
+            ("reference", [REF_CLI]),
+            ("lightgbm_tpu", [sys.executable, "-m", "lightgbm_tpu"])):
         if (tag == "reference" and args.skip_ref) or \
                 (tag == "lightgbm_tpu" and args.skip_tpu):
             continue
@@ -117,17 +118,36 @@ def main():
                                  iters=args.iters,
                                  threads=threads, tag=tag))
         print("running %s ..." % tag, flush=True)
-        dt, aucs = run_cli(cli + ["config=" + conf_path], tag)
-        results[tag] = (dt, aucs)
-        print("  %s: %.1f s, AUC trail %s" % (tag, dt, aucs[-3:]), flush=True)
+        if tag == "lightgbm_tpu":
+            # cold: FRESH persistent compilation cache (round-5 verdict
+            # flagged compile time hiding inside the measured wall); warm:
+            # same command again, executables load from the cache
+            cache_dir = "%s/jax_cache" % WORK
+            import shutil
+            shutil.rmtree(cache_dir, ignore_errors=True)
+            env = {"LIGHTGBM_TPU_CACHE_DIR": cache_dir}
+            cold, aucs = run_cli(cli + ["config=" + conf_path],
+                                 tag + "_cold", env)
+            warm, aucs_w = run_cli(cli + ["config=" + conf_path],
+                                   tag + "_warm", env)
+            results[tag] = ((cold, warm), aucs)
+            print("  %s: cold %.1f s / warm %.1f s, AUC trail %s"
+                  % (tag, cold, warm, aucs[-3:]), flush=True)
+            assert [a for _, a in aucs] == [a for _, a in aucs_w], \
+                "warm run must be numerically identical to cold"
+        else:
+            dt, aucs = run_cli(cli + ["config=" + conf_path], tag)
+            results[tag] = ((dt, dt), aucs)
+            print("  %s: %.1f s, AUC trail %s" % (tag, dt, aucs[-3:]),
+                  flush=True)
 
     if len(results) == 2:
         write_report(args, threads, results)
 
 
 def write_report(args, threads, results):
-    rd, ra = results["reference"]
-    td, ta = results["lightgbm_tpu"]
+    (rd_cold, rd_warm), ra = results["reference"]
+    (td_cold, td_warm), ta = results["lightgbm_tpu"]
     ra_d = dict(ra)
     ta_d = dict(ta)
     common = sorted(set(ra_d) & set(ta_d))
@@ -138,15 +158,20 @@ def write_report(args, threads, results):
         "Setup: %d train / %d valid rows x 28 features (synthetic binary "
         "task), `num_leaves=255, max_bin=255, learning_rate=0.1, "
         "min_data_in_leaf=20`, %d iterations — one config file consumed "
-        "by BOTH binaries (`tools/head_to_head.py`)."
+        "by BOTH binaries (`tools/head_to_head.py`).  Cold = fresh "
+        "persistent-compilation-cache (pays XLA/Mosaic compiles); warm = "
+        "second identical invocation (executables load from the cache; "
+        "numerically identical trajectory, asserted)."
         % (args.rows, max(args.rows // 10, 10_000), args.iters),
         "",
-        "| binary | hardware | wall-clock | final valid AUC |",
-        "|---|---|---|---|",
+        "| binary | hardware | cold wall-clock | warm wall-clock | "
+        "final valid AUC |",
+        "|---|---|---|---|---|",
         "| reference CLI (`/tmp/refbuild/lightgbm`) | %d-core CPU (this "
-        "box) | %.1f s | %.6f |" % (threads, rd, ra[-1][1] if ra else -1),
-        "| lightgbm_tpu CLI | 1x TPU v5e | %.1f s | %.6f |"
-        % (td, ta[-1][1] if ta else -1),
+        "box) | %.1f s | %.1f s | %.6f |"
+        % (threads, rd_cold, rd_warm, ra[-1][1] if ra else -1),
+        "| lightgbm_tpu CLI | 1x TPU v5e | %.1f s | %.1f s | %.6f |"
+        % (td_cold, td_warm, ta[-1][1] if ta else -1),
         "",
         "AUC by iteration (valid set):",
         "",
@@ -168,7 +193,8 @@ def write_report(args, threads, results):
         "Wall-clock caveat: this box exposes ONE CPU core; the reference's "
         "published Higgs CPU baseline (238.5 s, BASELINE.md) used 2x "
         "E5-2670v3 and remains the throughput denominator for bench.py. "
-        "The TPU time includes XLA compilation on first run.",
+        "Cold TPU time includes XLA/Mosaic compilation; warm is the "
+        "steady-state CLI cost a user pays on every run after the first.",
     ]
     with open(os.path.join(REPO, "HEADTOHEAD.md"), "w") as fh:
         fh.write("\n".join(lines) + "\n")

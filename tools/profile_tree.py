@@ -113,8 +113,28 @@ def main() -> None:
             arr = lrn.train(g, h, n)
             int(arr.num_leaves)
 
+    # --nsrow: also print each op's device time per LOGICAL row-visit, the
+    # unit PERF.md's per-phase table uses.  Row-visits are exact from the
+    # trained tree (every row passes one window per level) — the same
+    # accounting bench.py uses for device_util.
+    visits = None
+    if "--nsrow" in sys.argv:
+        if chunk:
+            trees = b.models[-3:]
+            visits = 0.0
+            for t in trees:
+                nl = t.num_leaves
+                visits += float(np.sum(t.leaf_count[:nl] * t.leaf_depth[:nl]))
+        else:
+            nl = int(arr.num_leaves)
+            visits = float(np.sum(np.asarray(arr.leaf_count)[:nl]
+                                  * np.asarray(arr.leaf_depth)[:nl]))
     for name, ms, c in aggregate_xplane(trace_dir):
-        print("%-88s %9.3f ms x%5d" % (name[:86], ms, c))
+        if visits:
+            print("%-74s %9.3f ms x%5d %8.3f ns/row-visit"
+                  % (name[:72], ms, c, ms * 1e6 / visits))
+        else:
+            print("%-88s %9.3f ms x%5d" % (name[:86], ms, c))
 
 
 if __name__ == "__main__":
