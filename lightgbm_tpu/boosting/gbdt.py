@@ -729,6 +729,13 @@ class GBDT:
                       forced=learner.forced,
                       packed_cols=learner.packed_cols,
                       hist_pool_slots=learner.hist_pool_slots,
+                      # round-7 size-bucketed fused kernels: the plan is
+                      # trace-static (derived from the static row count or
+                      # pinned by the learner), so the whole lax.scan still
+                      # compiles once; only the per-split window size picks
+                      # the branch at run time
+                      bucket_plan=learner.bucket_plan,
+                      pallas_interpret=learner.pallas_interpret,
                       carried=True)
 
         def f32col(rows, off):
@@ -827,7 +834,9 @@ class GBDT:
                       unpack_lanes=learner.unpack_lanes,
                       forced=learner.forced,
                       packed_cols=learner.packed_cols,
-                      hist_pool_slots=learner.hist_pool_slots)
+                      hist_pool_slots=learner.hist_pool_slots,
+                      bucket_plan=learner.bucket_plan,
+                      pallas_interpret=learner.pallas_interpret)
 
         bag = self._fused_bag()
         bag_seed = int(self.config.bagging_seed)

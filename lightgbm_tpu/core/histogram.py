@@ -749,18 +749,21 @@ def histogram_rows(rows: jax.Array, num_bins: int, start, count, *,
                    num_features: int, voff: int, bpc: int = 1,
                    packed: bool = False,
                    use_pallas: bool | None = None,
-                   f_begin=0) -> jax.Array:
+                   f_begin=0, interpret: bool = False) -> jax.Array:
     """Masked histogram over a combined row store; Pallas on TPU.
 
     ``f_begin``: feature-window base (may be traced) — feature-parallel
-    shards histogram only columns [f_begin, f_begin + num_features)."""
+    shards histogram only columns [f_begin, f_begin + num_features).
+    ``interpret``: run the Pallas path in interpret mode (CPU tests of the
+    fused builder)."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas and rows.shape[0] % 2048 == 0:
         return histogram_pallas_rows(rows, num_bins, start, count,
                                      num_features=num_features, voff=voff,
                                      bpc=bpc, packed=packed,
-                                     exact=_exact_hist(), f_begin=f_begin)
+                                     exact=_exact_hist(), f_begin=f_begin,
+                                     interpret=interpret)
     if isinstance(f_begin, int) and f_begin == 0:
         bins, values = rows_split_xla(rows, num_features, voff, bpc, packed)
         return histogram_xla_masked(bins, values, num_bins, start, count)

@@ -12,27 +12,42 @@ learner's copy/kernel overlap (src/treelearner/gpu_tree_learner.cpp:952-1055)
   copies of the whole row store every split (PERF.md).  Together those were
   ~45% of every boosting iteration.
 - This kernel instead streams the parent leaf's window through VMEM in
-  ``CHUNK``-row double-buffered tiles, routes each row (same binned-decision
+  ``chunk``-row double-buffered tiles, routes each row (same binned-decision
   semantics as ``tree_learner._route_left``), and *places* rows with a one-hot
   permutation matmul on the MXU — left rows compact to the window's front
   (in-place, behind the read cursor), right rows stream to a scratch region
   and are copied back after the left block settles.  Every HBM touch is a
   contiguous >=64 KB DMA at a 32-row-aligned offset: zero per-row descriptors,
-  no switch, cost proportional to the window, a single compiled code path for
-  every window size (which also keeps program size flat in N — the round-3
-  bucketed switch grew it).
+  no switch, cost proportional to the window.
 - The smaller child's histogram (serial_tree_learner.cpp:347-356 subtraction
   trick feeds on it) accumulates in the same pass from the same VMEM tiles —
   the routing/scatter/histogram fusion PERF.md round 3 listed as the next
   lever.
 - Round 6: the chunk loop is SOFTWARE-PIPELINED — phase C (scalar blends +
-  flushes) trails one chunk behind phases A/B on double-banked totals and
-  placement buffers, so the per-chunk totals VMEM->SMEM round-trip and the
-  flush-semaphore waits overlap the next chunk's matmuls instead of
-  stalling them (round 5 measured phase A at ~10x its isolated compute
-  replica, all scheduling); the per-feature-group histogram loops are
-  ROLLED (dynamic group index) so program size stays O(1) in F and wide-F
-  row stores compile.
+  flushes) trails behind phases A/B on banked totals and placement buffers,
+  so the per-chunk totals VMEM->SMEM round-trip and the flush-semaphore
+  waits overlap the next chunk's matmuls instead of stalling them (round 5
+  measured phase A at ~10x its isolated compute replica, all scheduling);
+  the per-feature-group histogram loops are ROLLED (dynamic group index) so
+  program size stays O(1) in F and wide-F row stores compile.
+- Round 7 (size-bucketed kernels): per-split cost now scales with the leaf
+  WINDOW instead of paying one fixed CHUNK=4096 pipeline on every split —
+  the documented remaining gap in the 1M-row head-to-head, where deep-tree
+  leaf windows shrink below one chunk and per-split fixed cost dominates:
+  (a) the totals round-trip is ONE VMEM->SMEM DMA per ``totk`` chunks (the
+  double-banked layout generalized to group banks; phase C trails ``totk``
+  chunks behind A/B instead of one), (b) ``chunk`` itself is a parameter —
+  1024 for mid windows so they stop padding to the 4096-row floor, 4096 for
+  the streaming regime — and (c) a SMALL-WINDOW kernel variant handles
+  sub-chunk leaves (the majority of splits at num_leaves=255 on <=1M rows):
+  single chunk, no input ring, no deferred phase C, no totals DMA at all —
+  lane-resident totals drive an in-register permutation and one write-back
+  DMA.  :func:`fused_bucket_plan` is the dispatch schedule the tree builder
+  switches over (bucket choice by window size; the variant set is
+  trace-static so the fused ``lax.scan`` boosting path compiles once).
+  All variants share the same phase-A/histogram building blocks, so
+  interpret-mode numerics are bit-exact across buckets (pinned by
+  tests/test_partition_buckets.py).
 
 Mosaic constraints honored (probed on v5e): no u8 vector arithmetic (u8 used
 only for DMA/select; math in i32/bf16/f32), no dynamic sublane rotate on u8
@@ -57,7 +72,10 @@ from .histogram import (_accum_factored_all, _accum_onehot_all,
 
 _LANE = 128
 _ALIGN = 32          # u8 sublane tile: dynamic DMA offsets must be 32-row mult
-CHUNK = 4096         # rows per streamed DMA tile
+CHUNK = 4096         # rows per streamed DMA tile of the LARGE bucket; also
+                     # the row-store padding contract (spare rows past every
+                     # window) every variant relies on
+SMALL_CHUNK = 1024   # the small-window kernel's single-chunk capacity
 T = 128              # rows per placement subtile (one P matmul)
 TS = 128             # staging/flush tile (rows per contiguous write-back)
 # Round-5 (2M-row window, v5e, full-kernel timings — phase knockouts are
@@ -65,21 +83,59 @@ TS = 128             # staging/flush tile (rows per contiguous write-back)
 # phase A/B + factored-MXU histogram rewrite took 9.29 -> 4.6 ns/row at
 # CHUNK=2048; CHUNK=4096 amortizes the per-chunk totals round-trip to
 # 4.12 (8192: 3.98, but doubles the minimum per-split window work that
-# small deep-tree leaves pay).  T=128 halves the placement one-hot vs 256
-# now that dest math is lane-major (the old layout charged small T back
-# in [CHUNK, 1] subtile slicing).
-NB = 36              # flush-ring depth per stream (>= CHUNK/TS + 2 so a
-                     # whole chunk can blend before its flushes start)
+# small deep-tree leaves pay — round 7 removes that floor with the bucket
+# schedule below instead).  T=128 halves the placement one-hot vs 256
+# now that dest math is lane-major.
 NIN = 3              # input-chunk ring depth: two reads in flight so the
                      # read DMA wait overlaps the previous chunk's phase
-                     # A/B matmuls AND the one-behind phase C (round 6)
-# The single-flush circular staging depends on nls <= TS per subtile (at most
-# one stage wrap per append) and the subtile loop covering the chunk exactly;
-# retuning one constant without the other silently corrupts the partition.
-assert T == TS and CHUNK % T == 0 and T % _ALIGN == 0 and TS % _ALIGN == 0
-assert NB * TS >= CHUNK + 2 * TS
+                     # A/B matmuls AND the trailing phase C (round 6)
+_MID_MAX = 16384     # bucket bound: windows <= this use the 1024-row chunk
+
+assert T == TS and T % _ALIGN == 0 and T == _LANE
 assert NIN >= 2
-assert 2 * (CHUNK // T) <= 128, "subtile totals must fit one [128, 2] SMEM bank"
+assert CHUNK % SMALL_CHUNK == 0 and SMALL_CHUNK % T == 0
+
+
+def _ring_depth(chunk: int) -> int:
+    """Flush-ring depth per stream: >= chunk/TS + 2 so a whole chunk can
+    blend before its flushes start (single-flush circular staging depends on
+    nls <= TS per subtile — at most one stage wrap per append — and the
+    subtile loop covering the chunk exactly; retuning one constant without
+    the other silently corrupts the partition)."""
+    return chunk // TS + 4
+
+
+def _totk(chunk: int) -> int:
+    """Chunks per totals VMEM->SMEM DMA window (round 7): one round-trip per
+    ~8192 rows.  The group-banked layout stores ``totk`` chunks' subtile
+    totals per bank; phase C trails ``totk`` chunks behind phase A/B, so the
+    DMA has a full group of matmuls to land behind (2 for chunk=4096, 8 for
+    chunk=1024)."""
+    return max(1, 8192 // chunk)
+
+
+def fused_bucket_plan(n: int) -> tuple:
+    """Trace-static dispatch schedule for the fused split pass over an
+    ``n``-row store: ``((small, chunk, max_wc), ..., (small, chunk, None))``,
+    buckets ascending, last bucket unbounded.  The tree builder selects the
+    bucket by the split window's row count (``jnp.searchsorted`` over the
+    bounds), so sub-chunk leaves pay the small kernel's single-chunk cost and
+    mid windows stop padding to the 4096-row floor; every variant is
+    bit-exact vs the others in interpret mode (same accumulation order).
+
+    The small bucket's bound leaves ``_ALIGN`` rows of slack: the kernel
+    processes [wb_al, wb_al + SMALL_CHUNK) and the window head offset
+    ``wb - wb_al`` can reach _ALIGN - 1."""
+    plan = []
+    small_max = SMALL_CHUNK - _ALIGN
+    if small_max < n:
+        plan.append((True, SMALL_CHUNK, small_max))
+    if _MID_MAX < n:
+        plan.append((False, SMALL_CHUNK, _MID_MAX))
+        plan.append((False, CHUNK, None))
+    else:
+        plan.append((False, SMALL_CHUNK, None))
+    return tuple(plan)
 
 
 def _route_tile(col, scal_ref, num_bins):
@@ -115,14 +171,130 @@ def _route_tile(col, scal_ref, num_bins):
     return jnp.where(is_cat, cat_left, num_left)
 
 
+# ---- phase-A building blocks shared by every kernel variant (round 7) ----
+# Bucketed kernels must stay BIT-EXACT against each other in interpret mode
+# (the dispatch assigns each window size to exactly one bucket, but the test
+# suite pins cross-variant equality so a retune can never shift numerics);
+# sharing the op sequence is what guarantees it.
+
+
+def _extract_col_lanes(ti_i8, gcol, *, W, bpc, packed, npk):
+    """ONE i8 x i8 -> i32 MXU dot extracts the split column for a whole
+    [npk*128, W] i8 tile, TRANSPOSED ([2, W] @ [R, W]^T) so the result and
+    the packed reshape stay lane-major; & 255 undoes the signed-byte wrap.
+    Returns the lane-packed [npk, 128] i32 bin codes."""
+    lanes_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    if packed:
+        colsel = (lanes_w == gcol // 2).astype(jnp.int8)
+        colsel2 = jnp.zeros((1, W), jnp.int8)
+    elif bpc == 2:
+        colsel = (lanes_w == 2 * gcol).astype(jnp.int8)
+        colsel2 = (lanes_w == 2 * gcol + 1).astype(jnp.int8)
+    else:
+        colsel = (lanes_w == gcol).astype(jnp.int8)
+        colsel2 = jnp.zeros((1, W), jnp.int8)
+    wmat = jnp.concatenate([colsel, colsel2], axis=0)        # [2, W]
+    extTi = jax.lax.dot_general(
+        wmat, ti_i8, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                    # [2, R]
+    lo_p = extTi[0:1, :].reshape(npk, _LANE) & 255
+    if packed:
+        return jnp.where(gcol % 2 == 1, (lo_p >> 4) & 15, lo_p & 15)
+    if bpc == 2:
+        return lo_p | ((extTi[1:2, :].reshape(npk, _LANE) & 255) << 8)
+    return lo_p
+
+
+def _subtile_prefixes(S_L, S_R, ltri, *, nsub):
+    """Per-subtile inclusive prefixes + per-side cumulative totals, all
+    lane-resident: S stacks the selection vectors as [2*nsub, T] lane-major
+    (row s = left stream of subtile s, row nsub+s = right) so the prefixes
+    are ONE [2*nsub, T] @ upper-tri[T, T] MXU dot and the cross-subtile
+    cumulative totals one tiny dot more.  Per-subtile totals <= T = 128, so
+    the f32/bf16 hop for the tiny triB dot stays exact.
+    Returns (pfxU [2*nsub, T] i32, tot_col, incl_col, excl_col [2*nsub, 1]
+    f32)."""
+    S = jnp.concatenate([S_L, S_R], axis=0).astype(jnp.int8)
+    pfxU = jax.lax.dot_general(
+        S, ltri[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)                    # [2*nsub, T]
+    tot_col = pfxU[:, T - 1:T].astype(jnp.float32)
+    # per-side cumulative totals (lower-tri within each block)
+    iiB = jax.lax.broadcasted_iota(jnp.int32, (2 * nsub, 1), 0)
+    jjB = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * nsub), 1)
+    triB = ((iiB >= jjB).astype(jnp.int32)
+            * ((iiB < nsub) == (jjB < nsub)).astype(jnp.int32)
+            ).astype(jnp.bfloat16)
+    incl_col = jax.lax.dot_general(
+        triB, tot_col.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [2*nsub, 1]
+    return pfxU, tot_col, incl_col, incl_col - tot_col
+
+
+def _hist_tile(ti_c, hist_ref, scal_ref, start, cnt, *, num_features,
+               num_bins, bpc, packed, exact, voff, f_shard):
+    """One [R, W] i32 row-store tile's histogram += contribution for the
+    rows at TILE-RELATIVE positions [start, start + cnt) — the shared
+    accumulation op of the streamed hist pass, the small-window kernel and
+    the copy-back-free right block (``start``/``cnt`` may be scalars or
+    [1, 1] lane vectors; out-of-range rows contribute exact zeros, so the
+    accumulated value is independent of the tile height R up to fp-identity
+    adds)."""
+    rows_n = ti_c.shape[0]
+    if _use_factored(num_features, num_bins):
+        # rolled fori_loop over feature groups (round 6): program size is
+        # O(p) in F, so wide-F row stores compile instead of unrolling
+        # hundreds of groups
+        ti_bf_h = ti_c.astype(jnp.bfloat16)
+        posT = jax.lax.broadcasted_iota(jnp.int32, (1, rows_n), 1)
+        inwT = ((posT >= start).astype(jnp.float32)
+                * (posT < start + cnt).astype(jnp.float32))
+        fb = (scal_ref[12 + num_bins // 32] if f_shard else 0)
+        v4T = _extract_values_T(ti_bf_h, voff=voff, exact=exact, inwT=inwT)
+        _accum_factored_all(ti_bf_h, v4T, hist_ref,
+                            num_features=num_features, num_bins=num_bins,
+                            bpc=bpc, packed=packed, f_base=fb)
+        return
+    # classic fallback (accumulators past the factored 4 MiB gate, i.e.
+    # wide F): rolled fori_loop over lane tiles with dynamic-index column
+    # extraction; the value path extracts via bf16 dots (it needs bf16
+    # operands anyway)
+    iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+    bwh = [(iota_lane == off).astype(jnp.bfloat16)
+           + (iota_lane == off + 1).astype(jnp.bfloat16) * 256
+           for off in (voff, voff + 2, voff + 4, voff + 6)]
+    wmat_h = jnp.concatenate(bwh, axis=0)                    # [4, W]
+    ext_h = jax.lax.dot_general(
+        ti_c.astype(jnp.bfloat16), wmat_h,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [R, 4]
+    exti_h = ext_h.astype(jnp.int32)
+    g = jax.lax.bitcast_convert_type(
+        exti_h[:, 0:1] | (exti_h[:, 1:2] << 16), jnp.float32)
+    h = jax.lax.bitcast_convert_type(
+        exti_h[:, 2:3] | (exti_h[:, 3:4] << 16), jnp.float32)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (rows_n, 1), 0)
+    inw = ((pos >= start).astype(jnp.float32)
+           * (pos < start + cnt).astype(jnp.float32))
+    vals = jnp.concatenate([g * inw, h * inw], axis=1)
+    v4 = _hilo_split(vals, axis=1, exact=exact)
+    colf = _colf_rows_dyn(ti_c, bpc=bpc, packed=packed)
+    _accum_onehot_all(colf, v4, hist_ref, num_features=num_features,
+                      num_bins=num_bins, contract_dim=0)
 
 
 def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
-                           packed, exact, f_shard=False, dbg_skip=""):
+                           packed, exact, f_shard=False, dbg_skip="",
+                           chunk=CHUNK):
     # f_shard: the histogrammed feature window starts at scal[12 + B//32]
     # (feature-parallel shards build only their own F/d block while routing
     # on the full row store); num_features is then the WINDOW's width
     del n_pad  # shapes come from the refs; kept for cache-key clarity
+    nb_ring = _ring_depth(chunk)
+    totk = _totk(chunk)
+    ncb = totk + 1           # comp_buf banks: totk chunks awaiting phase C
+                             # plus the chunk being placed
 
     def kernel(scal_ref, rows_in_ref, rows_ref, scratch_ref, hist_ref,
                stats_ref, inbuf, stage, ltri, rot, tmp, comp_buf,
@@ -130,11 +302,12 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                sem_in, sem_pre, sem_fl, sem_fr, sem_cb, sem_tot):
         # rows_in_ref is the pre-alias view of rows_ref (same buffer); all
         # reads and writes go through rows_ref so ordering is explicit.
-        # stage is a [2*NB, TS, W] ring: slots [0, NB) buffer the left
-        # stream, [NB, 2*NB) the right stream.  Flush DMAs are ASYNC — a
-        # slot's previous flush is awaited only when the ring wraps back to
-        # it (NB-1 flushes of slack), so the VPU/MXU never stalls on HBM
-        # writes (sync flushes were ~60% of the kernel in round-4 profiles).
+        # stage is a [2*nb_ring, TS, W] ring: slots [0, nb_ring) buffer the
+        # left stream, [nb_ring, 2*nb_ring) the right stream.  Flush DMAs
+        # are ASYNC — a slot's previous flush is awaited only when the ring
+        # wraps back to it (nb_ring-1 flushes of slack), so the VPU/MXU
+        # never stalls on HBM writes (sync flushes were ~60% of the kernel
+        # in round-4 profiles).
         del rows_in_ref
         wb = scal_ref[0]
         wc = scal_ref[1]
@@ -143,7 +316,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
         wb_al = pl.multiple_of((wb // _ALIGN) * _ALIGN, _ALIGN)
         headL = wb - wb_al
-        nchunks = (headL + wc + CHUNK - 1) // CHUNK
+        nchunks = (headL + wc + chunk - 1) // chunk
 
         hist_ref[...] = jnp.zeros_like(hist_ref)
         # upper-triangular ones U[j, t] = (j <= t): subtiles are STACKED
@@ -168,53 +341,56 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
         # deepened input ring: NIN - 1 reads in flight, so the chunk-read
         # semaphore wait overlaps the previous chunk's phase A/B matmuls and
-        # the one-behind phase C (software pipeline below)
+        # the trailing phase C (software pipeline below)
         for j in range(NIN - 1):
             @pl.when(j < nchunks)
             def _prologue(j=j):
                 pltpu.make_async_copy(
                     rows_ref.at[pl.ds(
-                        pl.multiple_of(wb_al + j * CHUNK, _ALIGN), CHUNK)],
+                        pl.multiple_of(wb_al + j * chunk, _ALIGN), chunk)],
                     inbuf.at[j], sem_in.at[j]).start()
 
         iota2ts1 = jax.lax.broadcasted_iota(jnp.int32, (2 * TS, 1), 0)
         iota_ts = jax.lax.broadcasted_iota(jnp.int32, (TS, 1), 0)
         totals_on = "totals" not in dbg_skip and "prefix" not in dbg_skip
-        nsub = CHUNK // T
-        npk = CHUNK // _LANE                   # lane-packed rows (row r ->
+        nsub = chunk // T
+        npk = chunk // _LANE                   # lane-packed rows (row r ->
                                                # [r // 128, r % 128])
 
         def wait_left(m):
-            sl = jax.lax.rem(m, NB)
+            sl = jax.lax.rem(m, nb_ring)
             pltpu.make_async_copy(
                 stage.at[sl], rows_ref.at[pl.ds(left_dst(m), TS)],
                 sem_fl.at[sl]).wait()
 
         def wait_right(m):
-            sl = jax.lax.rem(m, NB)
+            sl = jax.lax.rem(m, nb_ring)
             pltpu.make_async_copy(
-                stage.at[NB + sl],
+                stage.at[nb_ring + sl],
                 scratch_ref.at[pl.ds(pl.multiple_of(m * TS, _ALIGN), TS)],
                 sem_fr.at[sl]).wait()
 
-        # ---- software pipeline (round 6) ----
+        # ---- software pipeline (rounds 6-7) ----
         # The round-5 kernel ran A -> B -> totals-DMA-wait -> C per chunk:
         # the VMEM->SMEM totals round-trip and the flush-ring semaphore
         # waits sat on the critical path every chunk (PERF.md measured the
         # residual phase-A cost at ~10x its isolated compute replica — all
-        # scheduling).  Now phases A/B of chunk c run while chunk c-1's
-        # totals DMA is still in flight; phase C (scalar blends + flushes)
-        # trails ONE CHUNK behind, reading double-banked totals (SMEM) and
-        # placement tiles (comp_buf).  Phase B no longer needs the scalar
-        # fill counters — the cumulative placed-row counts ride the A/B
-        # stage as lane-resident [1, 1] vectors (cumLv/cumRv), bit-equal to
-        # the SMEM-derived scalars phase C still uses for DMA offsets.
+        # scheduling).  Round 6 deferred phase C one chunk; round 7 widens
+        # the totals window: chunks write their subtile totals into GROUP
+        # banks of ``totk`` chunks, ONE DMA per group ships the whole bank
+        # to SMEM, and phase C (scalar blends + flushes) trails ``totk``
+        # chunks behind phase A/B — the group's first phase C awaits a DMA
+        # that has had a full group of matmuls to land.  Phase B never
+        # needs the scalar fill counters — the cumulative placed-row counts
+        # ride the A/B stage as lane-resident [1, 1] vectors (cumLv/cumRv),
+        # bit-equal to the SMEM-derived scalars phase C still uses for DMA
+        # offsets.
         def chunk_ab(c, cum):
             cumLv, cumRv = cum
             slot = jax.lax.rem(c, NIN)
             pltpu.make_async_copy(
-                rows_ref.at[pl.ds(pl.multiple_of(wb_al + c * CHUNK, _ALIGN),
-                                  CHUNK)],
+                rows_ref.at[pl.ds(pl.multiple_of(wb_al + c * chunk, _ALIGN),
+                                  chunk)],
                 inbuf.at[slot], sem_in.at[slot]).wait()
 
             @pl.when(c + NIN - 1 < nchunks)
@@ -222,60 +398,33 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 nxt = jax.lax.rem(c + NIN - 1, NIN)
                 pltpu.make_async_copy(
                     rows_ref.at[pl.ds(
-                        pl.multiple_of(wb_al + (c + NIN - 1) * CHUNK,
-                                       _ALIGN), CHUNK)],
+                        pl.multiple_of(wb_al + (c + NIN - 1) * chunk,
+                                       _ALIGN), chunk)],
                     inbuf.at[nxt], sem_in.at[nxt]).start()
 
-            abs0 = wb_al + c * CHUNK
+            abs0 = wb_al + c * chunk
             # ---- phase A (vector): convert, route, per-subtile prefixes.
-            # EVERY per-row intermediate lives LANE-PACKED as [CHUNK/128, 128]
-            # — [CHUNK, 1]-shaped vectors are 128x vreg-padded on v5e and made
-            # this phase 2.6 ns/row in the round-5 knockout profile (~90% of
-            # phase A); the same math lane-packed is ~30 vregs per chunk.
-            # Per-subtile totals land in SMEM via ONE DMA (direct vector->
-            # scalar extraction costs ~0.7us EACH and does not pipeline).
-            # the streamed tile is used ONLY through i8 x i8 -> i32 MXU
-            # dots (probed exact on v5e), so a zero-cost bitcast VIEW
-            # replaces the round-4/5 u8 -> i32 -> bf16 tile converts;
+            # EVERY per-row intermediate lives LANE-PACKED as [chunk/128,
+            # 128] — [chunk, 1]-shaped vectors are 128x vreg-padded on v5e
+            # and made this phase 2.6 ns/row in the round-5 knockout profile
+            # (~90% of phase A); the same math lane-packed is ~30 vregs per
+            # chunk.  Per-subtile totals land in SMEM via group DMAs (direct
+            # vector->scalar extraction costs ~0.7us EACH and does not
+            # pipeline).  The streamed tile is used ONLY through i8 x i8 ->
+            # i32 MXU dots (probed exact on v5e), so a zero-cost bitcast
+            # VIEW replaces the round-4/5 u8 -> i32 -> bf16 tile converts;
             # signed-byte wrap is undone with & 255 after each dot
             if "convert" in dbg_skip:          # profiling: stream-only floor
-                ti_i8 = jnp.zeros((CHUNK, W), jnp.int8)
+                ti_i8 = jnp.zeros((chunk, W), jnp.int8)
             elif "statslot" in dbg_skip:       # profiling: static buffer read
                 ti_i8 = jax.lax.bitcast_convert_type(inbuf[0], jnp.int8)
             else:
                 ti_i8 = jax.lax.bitcast_convert_type(inbuf[slot], jnp.int8)
-            # ONE MXU dot extracts the split column for the whole chunk —
-            # TRANSPOSED ([2, W] @ [CHUNK, W]^T -> [2, CHUNK]) so the
-            # result and the packed reshape stay lane-major; i8 x i8 -> i32
-            # with & 255 undoing the signed-byte wrap.  (The post-partition
-            # histogram pass still extracts via bf16 dots: its value path
-            # needs bf16 operands anyway.)
-            lanes_w = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
-            if packed:
-                colsel = (lanes_w == gcol // 2).astype(jnp.int8)
-                colsel2 = jnp.zeros((1, W), jnp.int8)
-            elif bpc == 2:
-                colsel = (lanes_w == 2 * gcol).astype(jnp.int8)
-                colsel2 = (lanes_w == 2 * gcol + 1).astype(jnp.int8)
-            else:
-                colsel = (lanes_w == gcol).astype(jnp.int8)
-                colsel2 = jnp.zeros((1, W), jnp.int8)
             if "extract" in dbg_skip:          # profiling: no extract/route
                 col_p = jnp.zeros((npk, _LANE), jnp.int32)
             else:
-                wmat = jnp.concatenate([colsel, colsel2], axis=0)    # [2, W]
-                extTi = jax.lax.dot_general(
-                    wmat, ti_i8, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.int32)        # [2, CHUNK]
-                lo_p = extTi[0:1, :].reshape(npk, _LANE) & 255
-                if packed:
-                    col_p = jnp.where(gcol % 2 == 1, (lo_p >> 4) & 15,
-                                      lo_p & 15)
-                elif bpc == 2:
-                    col_p = lo_p | ((extTi[1:2, :].reshape(npk, _LANE)
-                                     & 255) << 8)
-                else:
-                    col_p = lo_p
+                col_p = _extract_col_lanes(ti_i8, gcol, W=W, bpc=bpc,
+                                           packed=packed, npk=npk)
             gl_p = _route_tile(col_p, scal_ref, num_bins)    # [npk, 128]
             pos_p = (abs0
                      + jax.lax.broadcasted_iota(jnp.int32, (npk, 1), 0)
@@ -285,57 +434,49 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                      * (pos_p < wb + wc).astype(jnp.int32))
             selL_p = gl_p * inw_p                            # i32 0/1
             selR_p = (1 - gl_p) * inw_p
-            # S stacks the selection vectors as [2*nsub, T] LANE-major (row s
-            # = left stream of subtile s, row nsub+s = right): per-subtile
-            # inclusive prefixes are then ONE [2*nsub, T] @ upper-tri[T, T]
-            # MXU dot, and cross-subtile cumulative totals one tiny dot more.
             assert T % _LANE == 0
             if T == _LANE:
                 S_L, S_R = selL_p, selR_p
             else:
                 S_L = selL_p.reshape(nsub, T)
                 S_R = selR_p.reshape(nsub, T)
-            bank = jax.lax.rem(c, 2)
+            # round-7 group banking: chunk c's totals live at bank row
+            # gpar*totk + kk, reused by chunk c + 2*totk — whose phase A
+            # runs only after this group's DMA was awaited by phase
+            # C(c - totk) (C trails totk chunks, so the reuse never races
+            # the in-flight copy)
+            kk = jax.lax.rem(c, totk)
+            gpar = jax.lax.rem(c // totk, 2)
+            bankt = gpar * totk + kk
+            bankb = jax.lax.rem(c, ncb)
             if "prefix" in dbg_skip:           # profiling: no prefix/totals
                 pfxU = jnp.zeros((2 * nsub, T), jnp.int32)
                 excl_col = jnp.zeros((2 * nsub, 1), jnp.float32)
                 incl_col = jnp.zeros((2 * nsub, 1), jnp.float32)
             else:
-                S = jnp.concatenate([S_L, S_R], axis=0).astype(jnp.int8)
-                pfxU = jax.lax.dot_general(
-                    S, ltri[...], (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32)        # [2*nsub, T]
-                # per-subtile totals <= T = 128, so the f32/bf16 hop
-                # for the tiny cross-subtile triB dot stays exact
-                tot_col = pfxU[:, T - 1:T].astype(jnp.float32)
-                # per-side cumulative totals (lower-tri within each block)
-                iiB = jax.lax.broadcasted_iota(jnp.int32, (2 * nsub, 1), 0)
-                jjB = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * nsub), 1)
-                triB = ((iiB >= jjB).astype(jnp.int32)
-                        * ((iiB < nsub) == (jjB < nsub)).astype(jnp.int32)
-                        ).astype(jnp.bfloat16)
-                incl_col = jax.lax.dot_general(
-                    triB, tot_col.astype(jnp.bfloat16),
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)      # [2*nsub, 1]
-                excl_col = incl_col - tot_col
+                pfxU, tot_col, incl_col, excl_col = _subtile_prefixes(
+                    S_L, S_R, ltri, nsub=nsub)
                 if totals_on:
-                    # the bank's previous DMA (chunk c - 2) was awaited by
-                    # phase C(c - 2), which ran during chunk c - 1's body —
-                    # the banked write below never races an in-flight copy.
-                    # Phase C(c) awaits this DMA only after chunk c + 1's
-                    # whole phase A/B, so the round-trip is off the
-                    # critical path instead of a per-chunk stall.
-                    totals_vm[bank, 0:2 * nsub, 0:1] = tot_col.astype(
+                    totals_vm[bankt, 0:2 * nsub, 0:1] = tot_col.astype(
                         jnp.int32)
-                    totals_vm[bank, 0:2 * nsub, 1:2] = incl_col.astype(
+                    totals_vm[bankt, 0:2 * nsub, 1:2] = incl_col.astype(
                         jnp.int32)
-                    pltpu.make_async_copy(totals_vm.at[bank],
-                                          totals_sm.at[bank],
-                                          sem_tot.at[bank]).start()
+
+                    @pl.when((kk == totk - 1) | (c == nchunks - 1))
+                    def _start_totals():
+                        # ONE DMA ships the whole group's totals (partial
+                        # final groups ship stale tail rows phase C never
+                        # reads); awaited by phase C of the group's FIRST
+                        # chunk, a full ``totk`` chunks of matmuls later,
+                        # so the round-trip is off the critical path
+                        base = pl.multiple_of(gpar * totk, totk)
+                        pltpu.make_async_copy(
+                            totals_vm.at[pl.ds(base, totk)],
+                            totals_sm.at[pl.ds(base, totk)],
+                            sem_tot.at[gpar]).start()
 
             # ---- phase B (vector, back-to-back with phase A — the totals
-            # DMA and the previous chunk's phase C overlap it): place every
+            # DMA and the trailing phase C overlap it): place every
             # subtile into this chunk's comp_buf bank.  The placement
             # one-hot is built TRANSPOSED — dest as a [1, T] lane vector
             # against a [2TS, 1] iota — so the dest math is lane-packed
@@ -358,56 +499,64 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                     Pt, ti_i8[s * T:(s + 1) * T, :],
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.int32)            # [2TS, W]
-                comp_buf[bank, s * 2 * TS:(s + 1) * 2 * TS, :] = (
+                comp_buf[bankb, s * 2 * TS:(s + 1) * 2 * TS, :] = (
                     comp_i & 255).astype(jnp.uint8)
 
             # per-side chunk totals ride the carry as [1, 1] vectors (exact:
-            # counts <= CHUNK << 2^24, and the bf16 operands of the incl dot
+            # counts <= chunk << 2^24, and the bf16 operands of the incl dot
             # are exact 0/1 and <= 128 values)
             totL = incl_col[nsub - 1:nsub, 0:1].astype(jnp.int32)
             totR = incl_col[2 * nsub - 1:2 * nsub, 0:1].astype(jnp.int32)
             return cumLv + totL, cumRv + totR
 
         def chunk_c(c, cc):
-            # phase C for chunk c (scalar blends + flushes), running ONE
-            # CHUNK behind phase A/B: by now the banked totals DMA has had a
-            # full chunk of matmuls to land, so the wait below is free in
-            # steady state.
+            # phase C for chunk c (scalar blends + flushes), running ``totk``
+            # CHUNKS behind phase A/B: the group's banked totals DMA has had
+            # a full group of matmuls to land, so the once-per-group wait
+            # below is free in steady state.
             fillL, fillR, nfL, nfR, wdL, wdR = cc
-            bank = jax.lax.rem(c, 2)
+            kk = jax.lax.rem(c, totk)
+            gpar = jax.lax.rem(c // totk, 2)
+            bankt = gpar * totk + kk
+            bankb = jax.lax.rem(c, ncb)
             if totals_on:
-                pltpu.make_async_copy(totals_vm.at[bank],
-                                      totals_sm.at[bank],
-                                      sem_tot.at[bank]).wait()
-                accL = fillL + totals_sm[bank, nsub - 1, 1]
-                accR = fillR + totals_sm[bank, 2 * nsub - 1, 1]
+                @pl.when(kk == 0)
+                def _await_totals():
+                    base = pl.multiple_of(gpar * totk, totk)
+                    pltpu.make_async_copy(
+                        totals_vm.at[pl.ds(base, totk)],
+                        totals_sm.at[pl.ds(base, totk)],
+                        sem_tot.at[gpar]).wait()
+                accL = fillL + totals_sm[bankt, nsub - 1, 1]
+                accR = fillR + totals_sm[bankt, 2 * nsub - 1, 1]
             else:                              # "prefix"/"totals" knockouts
                 accL, accR = fillL, fillR
             k1L = (headL + accL) // TS       # stream tiles complete after c
             k1R = accR // TS
 
-            # await ring slots this chunk will reuse (flushes older than NB)
+            # await ring slots this chunk will reuse (flushes older than the
+            # ring depth)
             if "flush" not in dbg_skip:
                 wdL = jax.lax.fori_loop(
-                    wdL, jnp.maximum(wdL, k1L - NB + 1),
+                    wdL, jnp.maximum(wdL, k1L - nb_ring + 1),
                     lambda m, w: (wait_left(m), w + 1)[1], wdL)
                 wdR = jax.lax.fori_loop(
-                    wdR, jnp.maximum(wdR, k1R - NB + 1),
+                    wdR, jnp.maximum(wdR, k1R - nb_ring + 1),
                     lambda m, w: (wait_right(m), w + 1)[1], wdR)
 
             for s in range(nsub) if "phaseC" not in dbg_skip else []:
-                compL = comp_buf[bank, s * 2 * TS:s * 2 * TS + TS, :]
-                compR = comp_buf[bank, s * 2 * TS + TS:(s + 1) * 2 * TS, :]
-                nls = totals_sm[bank, s, 0]
-                nrs = totals_sm[bank, nsub + s, 0]
-                baseL = fillL + totals_sm[bank, s, 1] - nls
-                baseR = fillR + totals_sm[bank, nsub + s, 1] - nrs
+                compL = comp_buf[bankb, s * 2 * TS:s * 2 * TS + TS, :]
+                compR = comp_buf[bankb, s * 2 * TS + TS:(s + 1) * 2 * TS, :]
+                nls = totals_sm[bankt, s, 0]
+                nrs = totals_sm[bankt, nsub + s, 0]
+                baseL = fillL + totals_sm[bankt, s, 1] - nls
+                baseR = fillR + totals_sm[bankt, nsub + s, 1] - nrs
                 startL = jax.lax.rem(headL + baseL, TS)
                 startR = jax.lax.rem(baseR, TS)
-                curL = jax.lax.rem((headL + baseL) // TS, NB)
-                nxtL = jax.lax.rem((headL + baseL) // TS + 1, NB)
-                curR = NB + jax.lax.rem(baseR // TS, NB)
-                nxtR = NB + jax.lax.rem(baseR // TS + 1, NB)
+                curL = jax.lax.rem((headL + baseL) // TS, nb_ring)
+                nxtL = jax.lax.rem((headL + baseL) // TS + 1, nb_ring)
+                curR = nb_ring + jax.lax.rem(baseR // TS, nb_ring)
+                nxtR = nb_ring + jax.lax.rem(baseR // TS + 1, nb_ring)
 
                 # blend the unwrapped circular ranges (masks in i32: Mosaic
                 # cannot truncate i8 bool vectors to i1)
@@ -434,16 +583,16 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
             # start this chunk's completed-tile flushes (scalar-only loops)
             def start_left(m, _):
-                sl = jax.lax.rem(m, NB)
+                sl = jax.lax.rem(m, nb_ring)
                 pltpu.make_async_copy(
                     stage.at[sl], rows_ref.at[pl.ds(left_dst(m), TS)],
                     sem_fl.at[sl]).start()
                 return 0
 
             def start_right(m, _):
-                sl = jax.lax.rem(m, NB)
+                sl = jax.lax.rem(m, nb_ring)
                 pltpu.make_async_copy(
-                    stage.at[NB + sl],
+                    stage.at[nb_ring + sl],
                     scratch_ref.at[pl.ds(pl.multiple_of(m * TS, _ALIGN), TS)],
                     sem_fr.at[sl]).start()
                 return 0
@@ -459,20 +608,22 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
         def pipe_body(c, carry):
             # steady state: A/B of chunk c overlaps the in-flight totals DMA
-            # of chunk c - 1, whose phase C runs right after (the inner
-            # fori_loop has exactly one trip for c >= 1 and zero for c = 0)
+            # of the previous group, whose phase C trails ``totk`` chunks
+            # behind (the inner fori_loop has exactly one trip for
+            # c >= totk and zero before)
             cumLv, cumRv, fillL, fillR, nfL, nfR, wdL, wdR = carry
             cumLv, cumRv = chunk_ab(c, (cumLv, cumRv))
-            cc = jax.lax.fori_loop(jnp.maximum(c - 1, 0), c, chunk_c,
+            cc = jax.lax.fori_loop(jnp.maximum(c - totk, 0),
+                                   jnp.maximum(c - totk + 1, 0), chunk_c,
                                    (fillL, fillR, nfL, nfR, wdL, wdR))
             return (cumLv, cumRv) + cc
 
         carry = jax.lax.fori_loop(
             0, nchunks, pipe_body,
             (zv, zv, zero, zero, zero, zero, zero, zero))
-        # pipeline epilogue: the last chunk's phase C
+        # pipeline epilogue: the trailing ``totk`` chunks' phase C
         fillL, fillR, nfL, nfR, wdL, wdR = jax.lax.fori_loop(
-            jnp.maximum(nchunks - 1, 0), nchunks, chunk_c, carry[2:])
+            jnp.maximum(nchunks - totk, 0), nchunks, chunk_c, carry[2:])
         nl = fillL
         nr = fillR
         stats_ref[0, 0] = nl
@@ -491,7 +642,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         @pl.when(pend_r > 0)
         def _final_right():
             cpf = pltpu.make_async_copy(
-                stage.at[NB + jax.lax.rem(nfR, NB)],
+                stage.at[nb_ring + jax.lax.rem(nfR, nb_ring)],
                 scratch_ref.at[pl.ds(pl.multiple_of(nfR * TS, _ALIGN), TS)],
                 sem_pre)
             cpf.start()
@@ -508,7 +659,8 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             cpa.start()
             cpa.wait()
             keep = iota_ts < pend_l
-            tmp[0, :, :] = jnp.where(keep, stage[jax.lax.rem(nfL, NB), :, :],
+            tmp[0, :, :] = jnp.where(keep,
+                                     stage[jax.lax.rem(nfL, nb_ring), :, :],
                                      tmp[0, :, :])
             cpb = pltpu.make_async_copy(tmp.at[0], rows_ref.at[pl.ds(src, TS)],
                                         sem_pre)
@@ -523,32 +675,24 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         # and the outer product rides the MXU contraction; wide-F datasets
         # fall back to the classic packed one-hot tiles.
         if "hist" not in dbg_skip:
-            factored = _use_factored(num_features, num_bins)
-            iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
-            bwh = [(iota_lane == off).astype(jnp.bfloat16)
-                   + (iota_lane == off + 1).astype(jnp.bfloat16) * 256
-                   for off in (voff, voff + 2, voff + 4, voff + 6)]
-            wmat_h = jnp.concatenate(bwh, axis=0)            # [4, W]
-            iota_c = jax.lax.broadcasted_iota(jnp.int32, (CHUNK, 1), 0)
-
             def hist_pass(src_ref, base_al, head, cnt):
-                nh = (head + cnt + CHUNK - 1) // CHUNK
+                nh = (head + cnt + chunk - 1) // chunk
 
                 for j in range(NIN - 1):
                     @pl.when(j < nh)
                     def _pro(j=j):
                         pltpu.make_async_copy(
                             src_ref.at[pl.ds(
-                                pl.multiple_of(base_al + j * CHUNK, _ALIGN),
-                                CHUNK)],
+                                pl.multiple_of(base_al + j * chunk, _ALIGN),
+                                chunk)],
                             inbuf.at[j], sem_in.at[j]).start()
 
                 def hbody(c, _):
                     slot = jax.lax.rem(c, NIN)
                     pltpu.make_async_copy(
                         src_ref.at[pl.ds(
-                            pl.multiple_of(base_al + c * CHUNK, _ALIGN),
-                            CHUNK)],
+                            pl.multiple_of(base_al + c * chunk, _ALIGN),
+                            chunk)],
                         inbuf.at[slot], sem_in.at[slot]).wait()
 
                     @pl.when(c + NIN - 1 < nh)
@@ -557,49 +701,15 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                         pltpu.make_async_copy(
                             src_ref.at[pl.ds(
                                 pl.multiple_of(base_al + (c + NIN - 1)
-                                               * CHUNK, _ALIGN), CHUNK)],
+                                               * chunk, _ALIGN), chunk)],
                             inbuf.at[nxt], sem_in.at[nxt]).start()
 
                     ti_c = inbuf[slot].astype(jnp.int32)
-                    if factored:
-                        # rolled fori_loop over feature groups (round 6):
-                        # program size is O(p) in F, so wide-F row stores
-                        # compile instead of unrolling hundreds of groups
-                        ti_bf_h = ti_c.astype(jnp.bfloat16)
-                        posT = (c * CHUNK + jax.lax.broadcasted_iota(
-                            jnp.int32, (1, CHUNK), 1))
-                        inwT = ((posT >= head).astype(jnp.float32)
-                                * (posT < head + cnt).astype(jnp.float32))
-                        fb = (scal_ref[12 + num_bins // 32] if f_shard
-                              else 0)
-                        v4T = _extract_values_T(ti_bf_h, voff=voff,
-                                                exact=exact, inwT=inwT)
-                        _accum_factored_all(ti_bf_h, v4T, hist_ref,
-                                            num_features=num_features,
-                                            num_bins=num_bins, bpc=bpc,
-                                            packed=packed, f_base=fb)
-                        return 0
-                    ext_h = jax.lax.dot_general(
-                        ti_c.astype(jnp.bfloat16), wmat_h,
-                        (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32)  # [CHUNK, 4]
-                    exti_h = ext_h.astype(jnp.int32)
-                    g = jax.lax.bitcast_convert_type(
-                        exti_h[:, 0:1] | (exti_h[:, 1:2] << 16), jnp.float32)
-                    h = jax.lax.bitcast_convert_type(
-                        exti_h[:, 2:3] | (exti_h[:, 3:4] << 16), jnp.float32)
-                    pos = c * CHUNK + iota_c
-                    inw = ((pos >= head).astype(jnp.float32)
-                           * (pos < head + cnt).astype(jnp.float32))
-                    vals = jnp.concatenate([g * inw, h * inw], axis=1)
-                    v4 = _hilo_split(vals, axis=1, exact=exact)
-                    # classic fallback (accumulators past the factored 4 MiB
-                    # gate, i.e. wide F): rolled fori_loop over lane tiles
-                    # with dynamic-index column extraction
-                    colf = _colf_rows_dyn(ti_c, bpc=bpc, packed=packed)
-                    _accum_onehot_all(colf, v4, hist_ref,
-                                      num_features=num_features,
-                                      num_bins=num_bins, contract_dim=0)
+                    _hist_tile(ti_c, hist_ref, scal_ref,
+                               head - c * chunk, cnt,
+                               num_features=num_features, num_bins=num_bins,
+                               bpc=bpc, packed=packed, exact=exact,
+                               voff=voff, f_shard=f_shard)
                     return 0
 
                 jax.lax.fori_loop(0, nh, hbody, 0)
@@ -613,7 +723,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 hist_pass(scratch_ref, 0, 0, nr)
 
         # ---- copy right block back: scratch[0:nr] -> rows[wb+nl ...) ----
-        # Same streamed-append machinery (double-buffered reads, NB-deep
+        # Same streamed-append machinery (double-buffered reads, nb_ring-deep
         # async flush ring on the left slots), with a constant row rotation
         # by the destination's 32-row phase.
         @pl.when(nr > 0)
@@ -632,7 +742,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 stage.at[0, pl.ds(0, _ALIGN)], sem_pre)
             cph.start()
             cph.wait()
-            ncb = (nr + TS - 1) // TS
+            ncbk = (nr + TS - 1) // TS
 
             pltpu.make_async_copy(
                 scratch_ref.at[pl.ds(0, TS)], tmp.at[0], sem_in.at[0]).start()
@@ -644,7 +754,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                     scratch_ref.at[pl.ds(pl.multiple_of(k * TS, _ALIGN), TS)],
                     tmp.at[slot], sem_in.at[slot]).wait()
 
-                @pl.when(k + 1 < ncb)
+                @pl.when(k + 1 < ncbk)
                 def _prefetch_cb():
                     nxt_in = 1 - slot
                     pltpu.make_async_copy(
@@ -661,8 +771,8 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 nvs = jnp.minimum(nr - k * TS, TS)
                 # valid source rows j < nvs sit at p=(ph+j)%TS
                 pj = jax.lax.rem(iota_ts - ph + TS, TS)          # j of pos p
-                cur = jax.lax.rem(nf, NB)
-                nxt = jax.lax.rem(nf + 1, NB)
+                cur = jax.lax.rem(nf, nb_ring)
+                nxt = jax.lax.rem(nf + 1, nb_ring)
                 mask_u = ((iota_ts >= ph).astype(jnp.int32)
                           * (pj < nvs).astype(jnp.int32))
                 stage[cur, :, :] = jnp.where(mask_u == 1, comp,
@@ -671,12 +781,13 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
                 @pl.when(cross)
                 def _flush_cb():
-                    @pl.when(nf >= NB - 1)
+                    @pl.when(nf >= nb_ring - 1)
                     def _await_prev():
                         pltpu.make_async_copy(
                             stage.at[nxt],
                             rows_ref.at[pl.ds(pl.multiple_of(
-                                d_al + (nf - (NB - 1)) * TS, _ALIGN), TS)],
+                                d_al + (nf - (nb_ring - 1)) * TS, _ALIGN),
+                                TS)],
                             sem_cb.at[nxt]).wait()
                     pltpu.make_async_copy(
                         stage.at[cur],
@@ -690,12 +801,12 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
                 return fill + nvs, nf + jnp.where(cross, 1, 0)
 
-            fill, nf = jax.lax.fori_loop(0, ncb, cb_body, (zero, zero))
-            for j in range(1, NB):
+            fill, nf = jax.lax.fori_loop(0, ncbk, cb_body, (zero, zero))
+            for j in range(1, nb_ring):
                 @pl.when(nf - j >= 0)
                 def _drain_cb(j=j):
                     idx = nf - j
-                    sl = jax.lax.rem(idx, NB)
+                    sl = jax.lax.rem(idx, nb_ring)
                     pltpu.make_async_copy(
                         stage.at[sl],
                         rows_ref.at[pl.ds(pl.multiple_of(
@@ -712,7 +823,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                 cpa.wait()
                 keep = iota_ts < pend
                 tmp[0, :, :] = jnp.where(keep,
-                                         stage[jax.lax.rem(nf, NB), :, :],
+                                         stage[jax.lax.rem(nf, nb_ring), :, :],
                                          tmp[0, :, :])
                 cpb = pltpu.make_async_copy(tmp.at[0],
                                             rows_ref.at[pl.ds(src, TS)],
@@ -723,14 +834,135 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
     return kernel
 
 
+def _make_small_partition_kernel(*, n_pad, W, num_features, num_bins, voff,
+                                 bpc, packed, exact, f_shard=False,
+                                 dbg_skip="", sc=SMALL_CHUNK):
+    """Round-7 small-window variant: the whole window fits ONE ``sc``-row
+    chunk (dispatch bound: wc <= sc - _ALIGN), so the entire streaming
+    apparatus disappears — no input ring, no flush rings, no deferred phase
+    C, no scratch output, and crucially NO totals VMEM->SMEM round-trip: the
+    per-subtile prefixes stay lane-resident and drive an in-register
+    permutation ([sc, T] one-hot dots accumulated into one [sc, W] tile),
+    the smaller child's histogram masks the same tile, and a single DMA
+    writes the window back.  Two DMAs + ~3*nsub matmuls total per split —
+    the fixed cost a sub-chunk deep-tree leaf actually pays.
+
+    Phase A (extract/route/prefix) and the histogram accumulation reuse the
+    pipelined kernel's building blocks verbatim, so results are bit-exact
+    against the full kernel on the same window (pinned by
+    tests/test_partition_buckets.py)."""
+    del n_pad
+    assert dbg_skip in ("", "hist"), \
+        "the small-window kernel only supports the 'hist' knockout"
+    nsub = sc // T
+    npk = sc // _LANE
+
+    def kernel(scal_ref, rows_in_ref, rows_ref, hist_ref, nl_ref,
+               inbuf, outbuf, ltri, sem):
+        del rows_in_ref
+        wb = scal_ref[0]
+        wc = scal_ref[1]
+        gcol = scal_ref[2]
+        hist_left = scal_ref[9]
+        wb_al = pl.multiple_of((wb // _ALIGN) * _ALIGN, _ALIGN)
+        headL = wb - wb_al
+
+        hist_ref[...] = jnp.zeros_like(hist_ref)
+        ltri[...] = (jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+                     <= jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+                     ).astype(jnp.int8)
+
+        # one read covers the whole window (+ head slack); rows past the
+        # window are carried through the identity permutation and written
+        # back byte-identical, so the RMW is safe for the neighbour leaf
+        cp = pltpu.make_async_copy(rows_ref.at[pl.ds(wb_al, sc)],
+                                   inbuf, sem)
+        cp.start()
+        cp.wait()
+        ti_i8 = jax.lax.bitcast_convert_type(inbuf[...], jnp.int8)
+
+        # ---- phase A: shared extract/route/prefix, all lane-resident ----
+        col_p = _extract_col_lanes(ti_i8, gcol, W=W, bpc=bpc, packed=packed,
+                                   npk=npk)
+        gl_p = _route_tile(col_p, scal_ref, num_bins)        # [npk, 128]
+        pos_p = (wb_al
+                 + jax.lax.broadcasted_iota(jnp.int32, (npk, 1), 0) * _LANE
+                 + jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1))
+        inw_p = ((pos_p >= wb).astype(jnp.int32)
+                 * (pos_p < wb + wc).astype(jnp.int32))
+        selL_p = gl_p * inw_p
+        selR_p = (1 - gl_p) * inw_p
+        if T == _LANE:
+            S_L, S_R = selL_p, selR_p
+        else:
+            S_L = selL_p.reshape(nsub, T)
+            S_R = selR_p.reshape(nsub, T)
+        pfxU, _tot, incl_col, excl_col = _subtile_prefixes(S_L, S_R, ltri,
+                                                          nsub=nsub)
+        nlv = incl_col[nsub - 1:nsub, 0:1].astype(jnp.int32)     # [1, 1]
+
+        # ---- placement: window-global destinations, no staging ring ----
+        # dest is a permutation of [0, sc): left rows compact to
+        # [headL, headL + nl), right rows to [headL + nl, headL + wc),
+        # out-of-window rows keep their own position — one [sc, T] one-hot
+        # dot per subtile accumulates the permuted tile (each output row
+        # receives exactly one contribution)
+        iota_sc = jax.lax.broadcasted_iota(jnp.int32, (sc, 1), 0)
+        iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        comp_i = jnp.zeros((sc, W), jnp.int32)
+        for s in range(nsub):
+            selLs = S_L[s:s + 1, :]
+            selRs = S_R[s:s + 1, :]
+            pfxLs = pfxU[s:s + 1, :]
+            pfxRs = pfxU[nsub + s:nsub + s + 1, :]
+            bL = excl_col[s:s + 1, 0:1].astype(jnp.int32)
+            bR = excl_col[nsub + s:nsub + s + 1, 0:1].astype(jnp.int32)
+            destL = headL + bL + pfxLs - 1
+            destR = headL + nlv + bR + pfxRs - 1
+            own = s * T + iota_lane
+            dest = jnp.where(selLs == 1, destL,
+                             jnp.where(selRs == 1, destR, own))
+            Pt = (dest == iota_sc).astype(jnp.int8)              # [sc, T]
+            comp_i = comp_i + jax.lax.dot_general(
+                Pt, ti_i8[s * T:(s + 1) * T, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)                # [sc, W]
+        outbuf[...] = (comp_i & 255).astype(jnp.uint8)
+
+        # left count out via a plain VMEM [1, 1] write — no SMEM totals DMA
+        # and no vector->scalar extraction anywhere in this variant
+        nl_ref[...] = nlv
+
+        # ---- smaller child's histogram from the SAME resident tile ----
+        if "hist" not in dbg_skip:
+            ti_c = outbuf[...].astype(jnp.int32)
+            start = jnp.where(hist_left == 1,
+                              jnp.full((1, 1), 1, jnp.int32) * headL,
+                              headL + nlv)
+            cnt = jnp.where(hist_left == 1, nlv, wc - nlv)
+            _hist_tile(ti_c, hist_ref, scal_ref, start, cnt,
+                       num_features=num_features, num_bins=num_bins,
+                       bpc=bpc, packed=packed, exact=exact, voff=voff,
+                       f_shard=f_shard)
+
+        # ---- single write-back DMA ----
+        cpo = pltpu.make_async_copy(outbuf, rows_ref.at[pl.ds(wb_al, sc)],
+                                    sem)
+        cpo.start()
+        cpo.wait()
+
+    return kernel
+
+
 @functools.partial(jax.jit, static_argnames=(
     "num_features", "num_bins", "voff", "bpc", "packed", "exact", "interpret",
-    "dbg_skip"))
+    "dbg_skip", "chunk", "small"))
 def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
                           *, num_features: int,
                           num_bins: int, voff: int, bpc: int = 1,
                           packed: bool = False, exact: bool = False,
-                          interpret: bool = False, dbg_skip: str = ""):
+                          interpret: bool = False, dbg_skip: str = "",
+                          chunk: int = CHUNK, small: bool = False):
     """Fused split pass over a combined row store.
 
     ``dbg_skip``: comma-joined phase knockouts for device profiling only
@@ -739,6 +971,14 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     additionally zero the chunk fill counters, so even row counts lie).
     Knockout timings are scheduling-sensitive (zeroed inputs constant-fold
     downstream phases); trust whole-kernel A/B timings over deltas.
+
+    ``chunk``/``small`` (round 7): size-bucketed kernel variants.  ``chunk``
+    sets the streamed tile height of the pipelined kernel (1024 or 4096 —
+    must divide the module CHUNK padding contract); ``small=True`` selects
+    the single-chunk small-window kernel, valid ONLY for windows with
+    ``wc <= chunk - _ALIGN`` (the dispatch schedule from
+    :func:`fused_bucket_plan` guarantees it; direct callers must too).
+    Every variant is bit-exact against the others in interpret mode.
 
     rows: [N_pad, W] u8 row store, N_pad a multiple of CHUNK.  CONTRACT: the
       caller must keep every window end <= N_pad - CHUNK (the streaming loop
@@ -760,6 +1000,8 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     """
     n_pad, W = rows.shape
     assert n_pad % CHUNK == 0, "pad the row store to a multiple of CHUNK"
+    assert CHUNK % chunk == 0 and chunk % T == 0, \
+        "bucketed chunk must divide the CHUNK padding contract"
     assert num_bins >= 32 and num_bins % 32 == 0, \
         "num_bins must be the >=32 kernel-block width (_pad_bins_pow2); " \
         "nibble-packed 16-bin data still scans at 32 lanes"
@@ -770,10 +1012,49 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
         assert not f_shard, \
             "the histogram feature window needs the factored path"
         hist_shape = (4, _padded_features(num_features, num_bins) * num_bins)
+
+    if small:
+        kernel = _make_small_partition_kernel(
+            n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
+            voff=voff, bpc=bpc, packed=packed, exact=exact, f_shard=f_shard,
+            dbg_skip=dbg_skip, sc=chunk)
+        rows_new, hist, nl = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),       # rows
+                ],
+                out_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),       # rows (aliased)
+                    pl.BlockSpec(memory_space=pltpu.VMEM),   # hist
+                    pl.BlockSpec(memory_space=pltpu.VMEM),   # nl
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((chunk, W), jnp.uint8),       # window tile in
+                    pltpu.VMEM((chunk, W), jnp.uint8),       # permuted tile
+                    pltpu.VMEM((T, T), jnp.int8),            # upper-tri ones
+                    pltpu.SemaphoreType.DMA,                 # read/write-back
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((n_pad, W), jnp.uint8),
+                jax.ShapeDtypeStruct(hist_shape, jnp.float32),
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            ],
+            input_output_aliases={1: 0},
+            interpret=interpret,
+        )(scal, rows)
+        return rows_new, hist, nl
+
+    nb_ring = _ring_depth(chunk)
+    totk = _totk(chunk)
+    nsub = chunk // T
     kernel = _make_partition_kernel(
         n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
         voff=voff, bpc=bpc, packed=packed, exact=exact, f_shard=f_shard,
-        dbg_skip=dbg_skip)
+        dbg_skip=dbg_skip, chunk=chunk)
     rows_new, _scratch, hist, nl = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -789,21 +1070,21 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
                 pl.BlockSpec(memory_space=pltpu.SMEM),   # nl
             ],
             scratch_shapes=[
-                pltpu.VMEM((NIN, CHUNK, W), jnp.uint8),  # streamed chunk ring
-                pltpu.VMEM((2 * NB, TS, W), jnp.uint8),  # L/R flush rings
+                pltpu.VMEM((NIN, chunk, W), jnp.uint8),  # streamed chunk ring
+                pltpu.VMEM((2 * nb_ring, TS, W), jnp.uint8),  # L/R flush rings
                 pltpu.VMEM((T, T), jnp.int8),            # upper-tri prefix ones
                 pltpu.VMEM((TS, TS), jnp.int8),          # copy-back rotation
                 pltpu.VMEM((2, TS, W), jnp.uint8),       # RMW/cb-read bounce
-                pltpu.VMEM((2, 2 * TS * (CHUNK // T), W),
-                           jnp.uint8),                   # placed, 2 banks
-                pltpu.VMEM((2, 128, 2), jnp.int32),      # subtile totals banks
-                pltpu.SMEM((2, 128, 2), jnp.int32),      # totals landing banks
+                pltpu.VMEM((totk + 1, 2 * TS * nsub, W),
+                           jnp.uint8),                   # placed, group banks
+                pltpu.VMEM((2 * totk, 2 * nsub, 2), jnp.int32),  # totals banks
+                pltpu.SMEM((2 * totk, 2 * nsub, 2), jnp.int32),  # totals land
                 pltpu.SemaphoreType.DMA((NIN,)),         # chunk/cb reads
                 pltpu.SemaphoreType.DMA,                 # prefills + finals
-                pltpu.SemaphoreType.DMA((NB,)),          # left flush ring
-                pltpu.SemaphoreType.DMA((NB,)),          # right flush ring
-                pltpu.SemaphoreType.DMA((NB,)),          # copy-back ring
-                pltpu.SemaphoreType.DMA((2,)),           # totals banks
+                pltpu.SemaphoreType.DMA((nb_ring,)),     # left flush ring
+                pltpu.SemaphoreType.DMA((nb_ring,)),     # right flush ring
+                pltpu.SemaphoreType.DMA((nb_ring,)),     # copy-back ring
+                pltpu.SemaphoreType.DMA((2,)),           # totals group banks
             ],
         ),
         out_shape=[

@@ -34,7 +34,8 @@ import numpy as np
 from .histogram import (build_histogram, histogram_rows, pack_nibbles,
                         partition_buckets, _exact_hist, _pad_bins,
                         _pad_bins_pow2, _use_factored)
-from .partition import (CHUNK as _PCHUNK, fold_hist, partition_hist_pallas)
+from .partition import (CHUNK as _PCHUNK, fold_hist, fused_bucket_plan,
+                        partition_hist_pallas)
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, per_feature_best_combined,
                     reduce_feature_best, sync_best, K_MIN_SCORE)
@@ -201,7 +202,7 @@ def _ffill_pair(flag: jax.Array, val: jax.Array):
                      "use_pallas", "has_categorical", "has_monotone",
                      "feat_num_bins", "packed_cols", "axis_name",
                      "comm_mode", "num_shards", "carried", "top_k",
-                     "hist_pool_slots"))
+                     "hist_pool_slots", "bucket_plan", "pallas_interpret"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -219,6 +220,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            carried: bool = False,
                            top_k: int = 20,
                            hist_pool_slots: int = 0,
+                           bucket_plan=None,
+                           pallas_interpret: bool = False,
                            rows_carry=None, extra=None, score_rate=None):
     """Leaf-wise growth with per-leaf physical row partitions.
 
@@ -242,6 +245,13 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     schedule of forced splits (serial_tree_learner.cpp:458 ForceSplits) — the
     first S splits are taken at those positions when valid, stats gathered at
     the given threshold; growth then continues best-first.
+    ``bucket_plan``: trace-static fused-kernel dispatch schedule (round 7;
+    see :func:`lightgbm_tpu.core.partition.fused_bucket_plan`) — sub-chunk
+    leaf windows select the single-chunk small-window kernel and mid windows
+    a 1024-row-chunk pipeline instead of padding every split to the
+    4096-row floor; ``None`` derives the schedule from the row count.
+    ``pallas_interpret`` runs every Pallas kernel in interpret mode so the
+    fused path (incl. this dispatch) is testable off-TPU.
     ``cegb``: optional (penalty_split [scalar], coupled [F], used0 [F]) cost
     penalties (cost_effective_gradient_boosting.hpp:50-61 DetlaGain):
     candidate gains lose tradeoff*penalty_split*num_data_in_leaf plus the
@@ -344,7 +354,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return histogram_rows(rows_mat, num_bins, start, count,
                               num_features=hist_fc, voff=voff, bpc=bpc,
                               packed=bool(packed_cols),
-                              use_pallas=use_pallas, f_begin=hist_f0)
+                              use_pallas=use_pallas, f_begin=hist_f0,
+                              interpret=pallas_interpret)
 
     def col_from_rows(wi, gcol):
         """Dynamic bin-column extract from [R, W] i32 row-store bytes."""
@@ -430,6 +441,42 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             return jax.lax.psum_scatter(h, axis_name, scatter_dimension=0,
                                         tiled=True)
         return jax.lax.psum(h, axis_name)
+
+    if fused:
+        # Round-7 size-bucketed fused dispatch: the split window's row count
+        # picks a kernel variant (single-chunk small-window kernel for
+        # sub-chunk leaves — the majority of splits at num_leaves=255 on
+        # <=1M rows — a 1024-row-chunk pipeline for mid windows, the
+        # 4096-row streaming pipeline above that), so per-split fixed cost
+        # scales with the leaf window instead of paying the one-size CHUNK
+        # pipeline every split.  The variant set is trace-static (static
+        # ``bucket_plan`` or derived from the static row count), so the
+        # fused lax.scan boosting path compiles once; the selector is the
+        # traced window size.  Variants are bit-exact against each other
+        # (partition.py round 7), so the bucket boundaries never shift
+        # numerics.  No collectives live inside the switch — shards may
+        # take different branches under shard_map.
+        plan = bucket_plan if bucket_plan is not None else fused_bucket_plan(n)
+
+        def _mk_fused(small_k, chunk_k):
+            def br(rows_m, scal_v):
+                return partition_hist_pallas(
+                    rows_m, scal_v, num_features=hist_fc, num_bins=num_bins,
+                    voff=voff, bpc=bpc, packed=bool(packed_cols),
+                    exact=_exact_hist(), chunk=chunk_k, small=small_k,
+                    interpret=pallas_interpret)
+            return br
+
+        fused_branches = [_mk_fused(s, c) for (s, c, _) in plan]
+        fused_bounds = (None if len(plan) == 1 else
+                        jnp.asarray([b for (_, _, b) in plan[:-1]],
+                                    jnp.int32))
+
+        def _fused_split(rows_m, scal_v, wcount):
+            if fused_bounds is None:
+                return fused_branches[0](rows_m, scal_v)
+            which = jnp.searchsorted(fused_bounds, wcount).astype(jnp.int32)
+            return jax.lax.switch(which, fused_branches, rows_m, scal_v)
 
     contri = (jnp.maximum(jnp.asarray(params.feature_contri, f32), 0.0)
               if params.feature_contri else None)
@@ -769,10 +816,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 scal = jnp.concatenate(
                     [scal, jnp.reshape(jnp.asarray(hist_f0, jnp.int32),
                                        (1,))])
-            rows_new, hist4, nl_arr = partition_hist_pallas(
-                st.rows, scal, num_features=hist_fc, num_bins=num_bins,
-                voff=voff, bpc=bpc, packed=bool(packed_cols),
-                exact=_exact_hist())
+            rows_new, hist4, nl_arr = _fused_split(st.rows, scal, wc)
             hist_small = fold_hist(hist4, hist_fc, num_bins)
             nl = nl_arr[0, 0]
             used_l = used_r = jnp.zeros((f,), f32)
@@ -1202,6 +1246,11 @@ class SerialTreeLearner:
         self.monotone = mono
         self.has_monotone = bool((mono != 0).any())
         self.use_pallas = jax.default_backend() == "tpu"
+        # round-7 fused-kernel dispatch: None derives the size-bucket
+        # schedule from the row count (partition.fused_bucket_plan); tests
+        # pin a plan and flip pallas_interpret to run the fused path off-TPU
+        self.bucket_plan = None
+        self.pallas_interpret = False
         self.grouped = bool(dataset.is_bundled and self.supports_groups)
         # histogram (kernel) width is the MXU-friendly power of two; the
         # per-feature scan width stays lane-padded only when group columns
@@ -1400,7 +1449,9 @@ class SerialTreeLearner:
             forced=self.forced, cegb=cegb,
             paid_bits=(self.cegb_paid if lazy_active else None),
             packed_cols=self.packed_cols,
-            hist_pool_slots=self.hist_pool_slots)
+            hist_pool_slots=self.hist_pool_slots,
+            bucket_plan=self.bucket_plan,
+            pallas_interpret=self.pallas_interpret)
         if lazy_active:
             # per-(row, feature) paid bits live for the whole training
             # (feature_used_in_data_)

@@ -1,0 +1,58 @@
+"""Full-trajectory parity vs the reference goldens (PARITY_ITERS=100).
+
+tests/test_parity.py runs a reduced number of iterations to keep tier-1
+fast; the goldens (tests/data/golden_metrics.json) were generated for 10/
+25/50/100 iterations, but until round 7 nothing in-tree ever exercised the
+100-iteration windows — they only ran if someone set PARITY_ITERS=100 by
+hand (VERDICT "weak": short-trajectory goldens).  These slow-marked tests
+pin the full-trajectory runs so deep-tree late-iteration behavior (tiny
+leaf windows — exactly the regime the round-7 size-bucketed kernels
+serve — plus score accumulation drift) is exercised by `pytest -m slow`.
+
+Tolerances are the quick tests' windows widened 1.5x: 100 iterations
+accumulate more RNG-stream divergence (bagging/feature sampling draw
+different streams than the reference) while staying within the reference's
+own GPU-vs-CPU equivalence band (docs/GPU-Performance.rst:134-158).
+"""
+import pytest
+
+from test_parity import check, run_config
+
+ITERS = 100
+
+# config name -> the quick test's tolerance window, widened 1.5x
+CASES = {
+    "binary_classification": {
+        "training auc": 0.03, "valid_1 auc": 0.0375,
+        "training binary_logloss": 0.06, "valid_1 binary_logloss": 0.06},
+    "regression": {"training l2": 0.03, "valid_1 l2": 0.03},
+    "multiclass_classification": {
+        "training multi_logloss": 0.09, "valid_1 multi_logloss": 0.12,
+        "training auc_mu": 0.045, "valid_1 auc_mu": 0.075},
+    "lambdarank": {
+        "training ndcg@5": 0.06, "valid_1 ndcg@5": 0.12,
+        "training ndcg@1": 0.075, "valid_1 ndcg@1": 0.12},
+    "dart": {
+        "training auc": 0.045, "valid_1 auc": 0.045,
+        "training binary_logloss": 0.09, "valid_1 binary_logloss": 0.09},
+    "goss": {
+        "training auc": 0.045, "valid_1 auc": 0.045,
+        "training binary_logloss": 0.075, "valid_1 binary_logloss": 0.075},
+    "rf": {
+        "training auc": 0.06, "valid_1 auc": 0.06,
+        "training binary_logloss": 0.09, "valid_1 binary_logloss": 0.09},
+    "monotone": {"training l2": 0.03, "valid_1 l2": 0.03},
+    "forced_splits": {
+        "training auc": 0.03, "valid_1 auc": 0.0375,
+        "training binary_logloss": 0.06, "valid_1 binary_logloss": 0.06},
+    "sparse_binary": {
+        "training auc": 0.03, "valid_1 auc": 0.045,
+        "training binary_logloss": 0.06, "valid_1 binary_logloss": 0.075},
+}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_parity_full_trajectory(name):
+    got = run_config(name, ITERS)
+    check(name, got, ITERS, CASES[name])

@@ -1,0 +1,224 @@
+"""Round-7 size-bucketed fused-kernel variants (interpret mode).
+
+Three contracts pinned here:
+
+1. Every variant — the single-chunk small-window kernel and each CHUNK
+   bucket of the pipelined kernel — matches the plain-XLA reference
+   (partition_hist_xla) on the usual tolerances: partition and left count
+   exact, histogram to 1e-4.
+2. Variants are BIT-EXACT against each other on the same window (rows, nl
+   and the folded histogram via array_equal): the kernels share the
+   phase-A/histogram building blocks, so dispatch-boundary retunes can
+   never shift numerics.  Bucket-boundary windows (CHUNK-1, CHUNK, CHUNK+1
+   rows) are covered for each bucket, plus the bpc=2 and nibble-packed
+   fallbacks.
+3. The fused tree-build path with buckets ENGAGED (build_tree_partitioned
+   dispatching through jax.lax.switch, and the whole fused lax.scan
+   boosting path) produces bit-identical trees to the same build pinned to
+   the single large-bucket plan.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.core.partition import (CHUNK, SMALL_CHUNK, _ALIGN,
+                                         fold_hist, fused_bucket_plan,
+                                         partition_hist_pallas,
+                                         partition_hist_xla)
+from test_partition_kernel import VOFF, make_rows
+
+N_PAD = 3 * CHUNK
+
+
+def run_variant(wb, wc, *, small, chunk, f=6, num_bins=32, seed=0, thr=11,
+                mt=0, dbin=0, is_cat=0, bitset=None, hist_left=1,
+                use_unfold=0, eoff=1, gcol=2, nb=None, bpc=1, packed=False,
+                n_pad=N_PAD):
+    assert wb + wc <= n_pad - CHUNK, "window contract: spare CHUNK of slack"
+    rows = make_rows(n_pad, f, num_bins, seed=seed, bpc=bpc, packed=packed)
+    nb = num_bins if nb is None else nb
+    scal = np.zeros(12 + num_bins // 32, dtype=np.int32)
+    scal[:12] = [wb, wc, gcol, thr, 1, mt, nb, dbin, is_cat, hist_left,
+                 use_unfold, eoff]
+    if bitset is not None:
+        scal[12:12 + len(bitset)] = np.asarray(bitset,
+                                               np.uint32).view(np.int32)
+    r_jax, s_jax = jnp.asarray(rows), jnp.asarray(scal)
+    got_rows, got_h4, got_nl = partition_hist_pallas(
+        r_jax, s_jax, num_features=f, num_bins=num_bins, voff=VOFF,
+        bpc=bpc, packed=packed, interpret=True, chunk=chunk, small=small)
+    want_rows, want_hist, want_nl = partition_hist_xla(
+        r_jax, s_jax, num_features=f, num_bins=num_bins, voff=VOFF,
+        bpc=bpc, packed=packed)
+    assert int(got_nl[0, 0]) == int(want_nl)
+    np.testing.assert_array_equal(np.asarray(got_rows), np.asarray(want_rows))
+    got_hist = np.asarray(fold_hist(got_h4, f, num_bins))
+    np.testing.assert_allclose(got_hist, np.asarray(want_hist),
+                               rtol=1e-4, atol=1e-4)
+    return np.asarray(got_rows), got_hist, int(got_nl[0, 0])
+
+
+def assert_bitwise(a, b):
+    """(rows, hist, nl) triples bit-identical across kernel variants."""
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    assert a[2] == b[2]
+
+
+SMALL_MAX = SMALL_CHUNK - _ALIGN
+
+
+@pytest.mark.parametrize("wb,wc", [
+    (0, 0),                       # empty window (dead builder iteration)
+    (777, 5),                     # tiny unaligned
+    (0, SMALL_MAX),               # the dispatch bound itself
+    (31, SMALL_MAX),              # max head offset + max window
+    (2 * CHUNK - 700, 700),       # window ends AT the spare-CHUNK contract
+                                  # edge (wb + wc == n_pad - CHUNK), wb
+                                  # unaligned (head offset 4)
+])
+def test_small_kernel_vs_reference_and_full(wb, wc):
+    got_s = run_variant(wb, wc, small=True, chunk=SMALL_CHUNK)
+    got_f = run_variant(wb, wc, small=False, chunk=CHUNK)
+    assert_bitwise(got_s, got_f)
+
+
+def test_small_kernel_missing_and_hist_side():
+    a = run_variant(50, 900, small=True, chunk=SMALL_CHUNK, mt=1, seed=8)
+    b = run_variant(50, 900, small=False, chunk=CHUNK, mt=1, seed=8)
+    assert_bitwise(a, b)
+    a = run_variant(100, 800, small=True, chunk=SMALL_CHUNK, hist_left=0,
+                    seed=7)
+    b = run_variant(100, 800, small=False, chunk=CHUNK, hist_left=0, seed=7)
+    assert_bitwise(a, b)
+
+
+def test_small_kernel_categorical_and_unfold():
+    bs = (1 << 1) | (1 << 5) | (1 << 17) | (1 << 30)
+    a = run_variant(300, 950, small=True, chunk=SMALL_CHUNK, is_cat=1,
+                    bitset=[bs], seed=10)
+    b = run_variant(300, 950, small=False, chunk=CHUNK, is_cat=1,
+                    bitset=[bs], seed=10)
+    assert_bitwise(a, b)
+    a = run_variant(300, 700, small=True, chunk=SMALL_CHUNK, use_unfold=1,
+                    eoff=4, nb=9, seed=11)
+    b = run_variant(300, 700, small=False, chunk=CHUNK, use_unfold=1,
+                    eoff=4, nb=9, seed=11)
+    assert_bitwise(a, b)
+
+
+def test_small_kernel_packed_and_bpc2():
+    a = run_variant(321, 930, small=True, chunk=SMALL_CHUNK, thr=7, nb=16,
+                    seed=13, packed=True)
+    b = run_variant(321, 930, small=False, chunk=CHUNK, thr=7, nb=16,
+                    seed=13, packed=True)
+    assert_bitwise(a, b)
+    a = run_variant(55, 880, small=True, chunk=SMALL_CHUNK, num_bins=512,
+                    thr=300, seed=15, bpc=2)
+    b = run_variant(55, 880, small=False, chunk=CHUNK, num_bins=512,
+                    thr=300, seed=15, bpc=2)
+    assert_bitwise(a, b)
+
+
+@pytest.mark.parametrize("wc", [SMALL_CHUNK - 1, SMALL_CHUNK,
+                                SMALL_CHUNK + 1])
+def test_mid_chunk_bucket_boundaries(wc):
+    """chunk=1024 pipelined variant at its own chunk boundary — the windows
+    where per-chunk bookkeeping (partial groups, k-chunk totals windows with
+    totk=8) is most likely to break."""
+    run_variant(123, wc, small=False, chunk=SMALL_CHUNK, seed=21)
+
+
+@pytest.mark.parametrize("wc", [CHUNK - 1, CHUNK, CHUNK + 1])
+def test_large_chunk_bucket_boundaries(wc):
+    """Both CHUNK buckets at the 4096-row boundary, bit-exact against each
+    other (4096+1 rows = 5 chunks of 1024: exercises a partial totals
+    group)."""
+    a = run_variant(123, wc, small=False, chunk=SMALL_CHUNK, seed=22)
+    b = run_variant(123, wc, small=False, chunk=CHUNK, seed=22)
+    assert_bitwise(a, b)
+
+
+def test_mid_chunk_packed_and_bpc2():
+    run_variant(100, 2500, small=False, chunk=SMALL_CHUNK, thr=7, nb=16,
+                seed=14, packed=True)
+    run_variant(55, 2800, small=False, chunk=SMALL_CHUNK, num_bins=512,
+                thr=300, seed=15, bpc=2)
+
+
+def test_mid_chunk_multi_group_totals():
+    """> totk chunks (8 x 1024 = one full totals group + change): the group
+    DMA fires mid-window, not only at the epilogue.  Needs a 4*CHUNK store
+    so the 2-chunk-plus window keeps its spare-CHUNK contract slack."""
+    a = run_variant(40, 2 * CHUNK + 900, small=False, chunk=SMALL_CHUNK,
+                    seed=23, n_pad=4 * CHUNK)
+    b = run_variant(40, 2 * CHUNK + 900, small=False, chunk=CHUNK, seed=23,
+                    n_pad=4 * CHUNK)
+    assert_bitwise(a, b)
+
+
+def test_bucket_plan_shapes():
+    plan = fused_bucket_plan(1 << 20)
+    assert plan[0][0] is True and plan[0][2] == SMALL_MAX
+    assert plan[-1][2] is None and plan[-1][1] == CHUNK
+    bounds = [b for (_, _, b) in plan[:-1]]
+    assert bounds == sorted(bounds)
+    # small stores never compile unreachable buckets
+    small_plan = fused_bucket_plan(8192)
+    assert small_plan[-1][1] == SMALL_CHUNK and len(small_plan) == 2
+
+
+# ---- the fused tree-build + fused lax.scan boosting path with buckets
+# engaged (interpret mode; TPU-only in production) ----
+
+
+def _toy_booster(n, monkeypatch_learner=None, iters=2):
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(n, 8))
+    y = X[:, 0] * 1.5 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=n)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=16)
+    cfg = Config(objective="regression", num_leaves=8, num_iterations=iters,
+                 min_data_in_leaf=2)
+    booster = GBDT(cfg, ds, create_objective("regression", cfg))
+    if monkeypatch_learner is not None:
+        monkeypatch_learner(booster.learner)
+    return booster
+
+
+def test_fused_scan_with_buckets():
+    """GBDT.train_chunk down the fused lax.scan path with the Pallas fused
+    split pass in interpret mode: the bucketed dispatch (small + mid kernels
+    engaged as leaf windows shrink) must produce bit-identical trees and
+    scores to the single-large-bucket plan (the round-6 status quo)."""
+    n = 4096  # multiple of CHUNK: the fused path engages without padding
+
+    results = {}
+    for name in ("buckets", "single"):
+        def pin(learner, name=name):
+            learner.use_pallas = True
+            learner.pallas_interpret = True
+            if name == "single":
+                learner.bucket_plan = ((False, CHUNK, None),)
+
+        b = _toy_booster(n, pin, iters=2)
+        assert b._can_fuse_iters()
+        b.train_chunk(2)
+        assert b.num_trees == 2
+        leaf_values = np.concatenate(
+            [np.asarray(t.leaf_value) for t in b.models])
+        thresholds = np.concatenate(
+            [np.asarray(t.threshold) for t in b.models])
+        scores = np.asarray(b.train_score)
+        results[name] = (leaf_values, thresholds, scores)
+        del b
+
+    got, want = results["buckets"], results["single"]
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+    np.testing.assert_array_equal(got[2], want[2])

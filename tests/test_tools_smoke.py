@@ -1,0 +1,77 @@
+"""Every tools/*.py must import and answer --help.
+
+PERF.md's measurement protocol names these tools per claim (BENCH_r07
+convention); a tool that no longer imports — a renamed kernel symbol, a
+moved module — silently rots the protocol.  This smoke test executes each
+tool as __main__ with --help inside ONE subprocess (a single jax import
+amortized over all of them), asserting argparse answers with a usage
+string and exit code 0 before any device work or heavy allocation starts.
+"""
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = sorted(os.path.basename(p)
+               for p in glob.glob(os.path.join(REPO, "tools", "*.py")))
+
+_DRIVER = r"""
+import contextlib, io, os, runpy, sys
+repo = sys.argv[1]
+failures = []
+for name in sys.argv[2:]:
+    path = os.path.join(repo, "tools", name)
+    sys.argv = [path, "--help"]
+    buf = io.StringIO()
+    code = None
+    try:
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+            runpy.run_path(path, run_name="__main__")
+    except SystemExit as e:  # argparse --help exits 0
+        code = 0 if e.code in (0, None) else e.code
+    except BaseException as e:  # noqa: BLE001
+        failures.append("%s: %r" % (name, e))
+        continue
+    out = buf.getvalue()
+    if code != 0:
+        failures.append("%s: exit code %r (%s)" % (name, code, out[:200]))
+    elif "usage" not in out.lower():
+        failures.append("%s: no usage text in --help output: %r"
+                        % (name, out[:200]))
+    else:
+        print("ok:", name)
+if failures:
+    print("FAILURES:")
+    for f in failures:
+        print(" ", f)
+    sys.exit(1)
+"""
+
+
+def test_every_tool_answers_help():
+    assert TOOLS, "no tools found"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-c", _DRIVER, REPO] + TOOLS,
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+    for name in TOOLS:
+        assert "ok: %s" % name in p.stdout, (name, p.stdout, p.stderr)
+
+
+def test_bench_split_cost_importable():
+    """The round-7 acceptance tool parses args and exposes its sweep/fit
+    entry points without touching jax at import time."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_split_cost
+    finally:
+        sys.path.pop(0)
+    args = bench_split_cost.parse_args(["--min-pow", "8", "--max-pow", "9"])
+    assert args.min_pow == 8 and args.max_pow == 9
+    icept, slope = bench_split_cost.fit_line([1.0, 2.0, 3.0],
+                                             [3.0, 5.0, 7.0])
+    assert icept == pytest.approx(1.0) and slope == pytest.approx(2.0)

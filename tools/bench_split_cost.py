@@ -1,0 +1,199 @@
+"""Per-split fixed cost vs window size for the fused split kernels.
+
+The 1M-row head-to-head loses to one CPU core because per-SPLIT fixed cost
+— not per-row compute — dominates once deep-tree leaf windows shrink below
+a few chunks (VERDICT r5 #2).  This tool measures exactly that: it sweeps
+window sizes 2^min-pow .. 2^max-pow rows, times one fused split pass per
+size for each kernel variant (the round-7 small-window kernel, the
+1024-row-chunk pipeline, the 4096-row-chunk pipeline, and whatever the
+dispatch schedule picks), and fits
+
+    time(wc) ~= intercept + slope * wc
+
+per variant — ``intercept`` is the ns/split fixed cost the bucket schedule
+exists to erase, ``slope`` the ns/row streaming cost.  Cold (first call:
+trace + compile) and warm (minimum of --reps post-warmup calls) are
+reported separately.
+
+Acceptance hook (ISSUE 2): on sub-chunk windows the small-window kernel's
+intercept must be <= 0.5x the full pipelined kernel's.  The ratio is
+printed and written to the JSON.
+
+Protocol:
+- off-TPU the kernels run in Pallas INTERPRET mode (automatic; or force
+  with --interpret): wall-clock there is an op-count proxy — interpret
+  executes the kernel's real chunk loops eagerly, so per-split machinery
+  (ring prologues, pipeline epilogues, copy-back) shows up as real time
+  while MXU-vs-VPU ratios do not.  Sub-chunk sweeps (the acceptance
+  comparison) default to 2^8..2^11 there.
+- on a TPU run the full sweep: ``python tools/bench_split_cost.py
+  --max-pow 21 --json BENCH_split_cost.json``; device wall-clock via
+  block_until_ready is trustworthy above ~100 us, and the per-variant
+  intercepts land in PERF.md's BENCH_r07 rows.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-split fixed cost (ns/split intercept + ns/row "
+                    "slope) per fused-kernel variant")
+    ap.add_argument("--min-pow", type=int, default=8,
+                    help="smallest window: 2^min-pow rows (default 8)")
+    ap.add_argument("--max-pow", type=int, default=None,
+                    help="largest window: 2^max-pow rows (default 21 on "
+                         "TPU, 11 in interpret mode)")
+    ap.add_argument("--reps", type=int, default=None,
+                    help="warm reps per point (default 10 on TPU, 5 "
+                         "interpret)")
+    ap.add_argument("--features", type=int, default=6)
+    ap.add_argument("--num-bins", type=int, default=32)
+    ap.add_argument("--interpret", action="store_true",
+                    help="force Pallas interpret mode (automatic off-TPU)")
+    ap.add_argument("--json", default="",
+                    help="write results to this JSON path")
+    return ap.parse_args(argv)
+
+
+def make_store(n_pad, f, num_bins, W=128, voff=32, seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    rows = np.zeros((n_pad, W), dtype=np.uint8)
+    rows[:, :f] = rng.randint(0, num_bins, size=(n_pad, f)).astype(np.uint8)
+    grad = rng.normal(size=n_pad).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n_pad).astype(np.float32)
+    rows[:, voff:voff + 4] = grad.view(np.uint8).reshape(n_pad, 4)
+    rows[:, voff + 4:voff + 8] = hess.view(np.uint8).reshape(n_pad, 4)
+    order = np.arange(n_pad, dtype=np.int32)
+    rows[:, voff + 8:voff + 12] = order.view(np.uint8).reshape(n_pad, 4)
+    return rows
+
+
+def fit_line(xs, ys):
+    """Least-squares (intercept, slope) of ys ~ a + b*xs."""
+    import numpy as np
+    A = np.stack([np.ones(len(xs)), np.asarray(xs, float)], axis=1)
+    coef, *_ = np.linalg.lstsq(A, np.asarray(ys, float), rcond=None)
+    return float(coef[0]), float(coef[1])
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from lightgbm_tpu.core.partition import (CHUNK, SMALL_CHUNK, _ALIGN,
+                                             fused_bucket_plan,
+                                             partition_hist_pallas)
+
+    interpret = args.interpret or jax.default_backend() != "tpu"
+    max_pow = args.max_pow or (11 if interpret else 21)
+    reps = args.reps or (5 if interpret else 10)
+    sizes = [1 << p for p in range(args.min_pow, max_pow + 1)]
+    # densify the sub-chunk regime: the acceptance ratio is an intercept
+    # fit there, and two powers of two make a degenerate line
+    sizes = sorted(set(sizes) | {s + s // 2 for s in sizes
+                                 if s + s // 2 <= SMALL_CHUNK - _ALIGN
+                                 and s + s // 2 <= max(sizes)})
+    voff, W = 32, 128
+    f, B = args.features, args.num_bins
+    n_pad = -(-(max(sizes) + CHUNK) // CHUNK) * CHUNK
+    rows = jnp.asarray(make_store(n_pad, f, B, W=W, voff=voff))
+    plan = fused_bucket_plan(max(sizes))
+
+    def pick(wc):
+        for small, chunk, bound in plan:
+            if bound is None or wc <= bound:
+                return small, chunk
+        return plan[-1][:2]
+
+    variants = {
+        "small": (True, SMALL_CHUNK),
+        "pipe1024": (False, SMALL_CHUNK),
+        "pipe4096": (False, CHUNK),
+    }
+
+    def run_one(wc, small, chunk):
+        scal = np.zeros(12 + B // 32, dtype=np.int32)
+        scal[:12] = [0, wc, 2, B // 2 - 1, 1, 0, B, 0, 0, 1, 0, 1]
+        s = jnp.asarray(scal)
+        t0 = time.perf_counter()
+        out = partition_hist_pallas(rows, s, num_features=f, num_bins=B,
+                                    voff=voff, interpret=interpret,
+                                    chunk=chunk, small=small)
+        jax.block_until_ready(out[1])
+        cold = time.perf_counter() - t0
+        warms = []
+        for i in range(reps + 1):
+            t0 = time.perf_counter()
+            out = partition_hist_pallas(rows, s, num_features=f, num_bins=B,
+                                        voff=voff, interpret=interpret,
+                                        chunk=chunk, small=small)
+            jax.block_until_ready(out[1])
+            if i:        # one extra untimed settle call after the cold run
+                warms.append(time.perf_counter() - t0)
+        # MIN of reps: microbench-standard for one-shot dispatch costs —
+        # scheduler/allocator noise only ever ADDS time
+        return cold, float(np.min(warms))
+
+    results = {"mode": "interpret" if interpret else "device",
+               "plan": [list(p) for p in plan], "points": [], "fits": {}}
+    print("mode=%s  sweep 2^%d..2^%d  reps=%d  F=%d B=%d"
+          % (results["mode"], args.min_pow, max_pow, reps, f, B))
+    print("%10s %10s %12s %12s %12s" % ("rows", "variant", "cold_ms",
+                                        "warm_ms", "ns/row(warm)"))
+    per_var = {}
+    for wc in sizes:
+        todo = dict(variants)
+        if wc > SMALL_CHUNK - _ALIGN:
+            todo.pop("small")
+        ds, dc = pick(wc)
+        todo["dispatch"] = (ds, dc)
+        for name, (small, chunk) in todo.items():
+            cold, warm = run_one(wc, small, chunk)
+            per_var.setdefault(name, []).append((wc, cold, warm))
+            results["points"].append(
+                {"rows": wc, "variant": name, "cold_s": cold,
+                 "warm_s": warm})
+            print("%10d %10s %12.3f %12.3f %12.2f"
+                  % (wc, name, cold * 1e3, warm * 1e3, warm * 1e9 / wc))
+
+    # fits: sub-chunk regime (<= SMALL_CHUNK rows) pins the intercept the
+    # small kernel exists to cut; the full range gives the streaming slope
+    for name, pts in per_var.items():
+        sub = [(w, c, h) for (w, c, h) in pts if w <= SMALL_CHUNK - _ALIGN]
+        use = sub if len(sub) >= 2 else pts
+        icept, slope = fit_line([p[0] for p in use], [p[2] for p in use])
+        results["fits"][name] = {"intercept_ns": icept * 1e9,
+                                 "slope_ns_per_row": slope * 1e9,
+                                 "points": len(use),
+                                 "regime": ("subchunk" if use is sub
+                                            else "full")}
+        print("%10s: intercept %.1f us/split, slope %.2f ns/row (%s, %d "
+              "pts)" % (name, icept * 1e6, slope * 1e9,
+                        results["fits"][name]["regime"], len(use)))
+
+    if "small" in results["fits"] and "pipe4096" in results["fits"]:
+        ratio = (results["fits"]["small"]["intercept_ns"]
+                 / max(results["fits"]["pipe4096"]["intercept_ns"], 1e-9))
+        results["small_over_full_intercept"] = ratio
+        bar = "PASS" if ratio <= 0.5 else "FAIL"
+        print("small-kernel intercept / full-kernel intercept = %.3f "
+              "(acceptance bar <= 0.5: %s)" % (ratio, bar))
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(results, fh, indent=1)
+        print("wrote", args.json)
+    return results
+
+
+if __name__ == "__main__":
+    main()

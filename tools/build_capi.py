@@ -206,5 +206,8 @@ def build(out_dir: str) -> str:
 
 
 if __name__ == "__main__":
-    out = sys.argv[1] if len(sys.argv) > 1 else "."
-    print(build(out))
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="build lib_lightgbm_tpu (cffi embedding of the C API)")
+    ap.add_argument("out_dir", nargs="?", default=".")
+    print(build(ap.parse_args().out_dir))

@@ -96,7 +96,12 @@ def run_reference(cli, name, example, overrides, workdir):
 
 
 def main():
-    cli = sys.argv[1] if len(sys.argv) > 1 else "/tmp/refbuild/lightgbm"
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="regenerate tests/data/golden_metrics.json from the "
+                    "reference CLI")
+    ap.add_argument("cli", nargs="?", default="/tmp/refbuild/lightgbm")
+    cli = ap.parse_args().cli
     with open(GOLDEN) as fh:
         golden = json.load(fh)
     with tempfile.TemporaryDirectory() as workdir:

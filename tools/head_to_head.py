@@ -11,7 +11,16 @@ GPU-vs-CPU comparisons treat ~1e-3 AUC as equivalent
 this box has ONE CPU core — the 238.5 s Higgs baseline ran on 2x E5-2670v3
 (28 cores), so BASELINE.md remains the throughput denominator.
 
+Round 7 adds the SMALL-WINDOW regime (``--regime small``): the same
+protocol at 100k AND 1M rows in one run, one report section per size.
+At num_leaves=255 these are the shapes where most splits sit below one
+4096-row chunk — the regime the size-bucketed kernels target — so the
+per-size wall-clocks are the acceptance measurement for the round-7
+bucket schedule (PERF.md BENCH_r07), alongside the 10.5M-row throughput
+headline bench.py keeps.
+
 Usage: python tools/head_to_head.py [--rows 1000000] [--iters 100]
+       python tools/head_to_head.py --regime small   # 100k + 1M rows
 """
 import argparse
 import os
@@ -94,30 +103,24 @@ def run_cli(cmd, tag, env_extra=None):
     return dt, parse_auc(log)
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=1_000_000)
-    ap.add_argument("--iters", type=int, default=100)
-    ap.add_argument("--skip-ref", action="store_true")
-    ap.add_argument("--skip-tpu", action="store_true")
-    args = ap.parse_args()
-    n_valid = max(args.rows // 10, 10_000)
-    gen_data(args.rows, n_valid)
-    threads = os.cpu_count()
-
+def run_size(rows, iters, threads, skip_ref=False, skip_tpu=False):
+    """One head-to-head at a fixed row count; returns {tag: ((cold, warm),
+    aucs)}."""
+    n_valid = max(rows // 10, 10_000)
+    gen_data(rows, n_valid)
     results = {}
     for tag, cli in (
             ("reference", [REF_CLI]),
             ("lightgbm_tpu", [sys.executable, "-m", "lightgbm_tpu"])):
-        if (tag == "reference" and args.skip_ref) or \
-                (tag == "lightgbm_tpu" and args.skip_tpu):
+        if (tag == "reference" and skip_ref) or \
+                (tag == "lightgbm_tpu" and skip_tpu):
             continue
-        conf_path = "%s/%s.conf" % (WORK, tag)
+        conf_path = "%s/%s_%d.conf" % (WORK, tag, rows)
         with open(conf_path, "w") as fh:
-            fh.write(CONF.format(work=WORK, rows=args.rows,
-                                 iters=args.iters,
-                                 threads=threads, tag=tag))
-        print("running %s ..." % tag, flush=True)
+            fh.write(CONF.format(work=WORK, rows=rows, iters=iters,
+                                 threads=threads,
+                                 tag="%s_%d" % (tag, rows)))
+        print("running %s (%d rows) ..." % (tag, rows), flush=True)
         if tag == "lightgbm_tpu":
             # cold: FRESH persistent compilation cache (round-5 verdict
             # flagged compile time hiding inside the measured wall); warm:
@@ -127,78 +130,122 @@ def main():
             shutil.rmtree(cache_dir, ignore_errors=True)
             env = {"LIGHTGBM_TPU_CACHE_DIR": cache_dir}
             cold, aucs = run_cli(cli + ["config=" + conf_path],
-                                 tag + "_cold", env)
+                                 "%s_%d_cold" % (tag, rows), env)
             warm, aucs_w = run_cli(cli + ["config=" + conf_path],
-                                   tag + "_warm", env)
+                                   "%s_%d_warm" % (tag, rows), env)
             results[tag] = ((cold, warm), aucs)
             print("  %s: cold %.1f s / warm %.1f s, AUC trail %s"
                   % (tag, cold, warm, aucs[-3:]), flush=True)
             assert [a for _, a in aucs] == [a for _, a in aucs_w], \
                 "warm run must be numerically identical to cold"
         else:
-            dt, aucs = run_cli(cli + ["config=" + conf_path], tag)
+            dt, aucs = run_cli(cli + ["config=" + conf_path], "%s_%d"
+                               % (tag, rows))
             results[tag] = ((dt, dt), aucs)
             print("  %s: %.1f s, AUC trail %s" % (tag, dt, aucs[-3:]),
                   flush=True)
-
-    if len(results) == 2:
-        write_report(args, threads, results)
+    return results
 
 
-def write_report(args, threads, results):
-    (rd_cold, rd_warm), ra = results["reference"]
-    (td_cold, td_warm), ta = results["lightgbm_tpu"]
-    ra_d = dict(ra)
-    ta_d = dict(ta)
-    common = sorted(set(ra_d) & set(ta_d))
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--regime", choices=("headline", "small"),
+                    default="headline",
+                    help="small = the round-7 small-window regime: 100k "
+                         "AND 1M rows in one report (deep-tree leaf "
+                         "windows below one chunk dominate both)")
+    ap.add_argument("--skip-ref", action="store_true")
+    ap.add_argument("--skip-tpu", action="store_true")
+    args = ap.parse_args()
+    threads = os.cpu_count()
+    rows_list = ([100_000, 1_000_000] if args.regime == "small"
+                 else [args.rows])
+
+    all_results = {}
+    for rows in rows_list:
+        res = run_size(rows, args.iters, threads,
+                       skip_ref=args.skip_ref, skip_tpu=args.skip_tpu)
+        if len(res) == 2:
+            all_results[rows] = res
+
+    if all_results:
+        write_report(args, threads, all_results)
+
+
+def write_report(args, threads, all_results):
+    """One report, one section per row count (the --regime small run emits
+    100k and 1M sections; headline emits one)."""
     lines = [
         "# Head-to-head vs the reference CLI (identical data, identical "
         "config)",
         "",
-        "Setup: %d train / %d valid rows x 28 features (synthetic binary "
-        "task), `num_leaves=255, max_bin=255, learning_rate=0.1, "
-        "min_data_in_leaf=20`, %d iterations — one config file consumed "
-        "by BOTH binaries (`tools/head_to_head.py`).  Cold = fresh "
+        "Protocol: 28-feature synthetic binary task, `num_leaves=255, "
+        "max_bin=255, learning_rate=0.1, min_data_in_leaf=20`, %d "
+        "iterations — one config file consumed by BOTH binaries "
+        "(`tools/head_to_head.py`%s).  Cold = fresh "
         "persistent-compilation-cache (pays XLA/Mosaic compiles); warm = "
         "second identical invocation (executables load from the cache; "
         "numerically identical trajectory, asserted)."
-        % (args.rows, max(args.rows // 10, 10_000), args.iters),
-        "",
-        "| binary | hardware | cold wall-clock | warm wall-clock | "
-        "final valid AUC |",
-        "|---|---|---|---|---|",
-        "| reference CLI (`/tmp/refbuild/lightgbm`) | %d-core CPU (this "
-        "box) | %.1f s | %.1f s | %.6f |"
-        % (threads, rd_cold, rd_warm, ra[-1][1] if ra else -1),
-        "| lightgbm_tpu CLI | 1x TPU v5e | %.1f s | %.1f s | %.6f |"
-        % (td_cold, td_warm, ta[-1][1] if ta else -1),
-        "",
-        "AUC by iteration (valid set):",
-        "",
-        "| iteration | reference | lightgbm_tpu | delta |",
-        "|---|---|---|---|",
+        % (args.iters,
+           " --regime small" if getattr(args, "regime", "") == "small"
+           else ""),
     ]
-    worst = 0.0
-    for it in common:
-        d = ta_d[it] - ra_d[it]
-        worst = max(worst, abs(d))
-        lines.append("| %d | %.6f | %.6f | %+0.6f |"
-                     % (it, ra_d[it], ta_d[it], d))
+    worst_all = 0.0
+    for rows in sorted(all_results):
+        results = all_results[rows]
+        (rd_cold, rd_warm), ra = results["reference"]
+        (td_cold, td_warm), ta = results["lightgbm_tpu"]
+        ra_d = dict(ra)
+        ta_d = dict(ta)
+        common = sorted(set(ra_d) & set(ta_d))
+        lines += [
+            "",
+            "## %d train / %d valid rows" % (rows, max(rows // 10, 10_000)),
+            "",
+            "| binary | hardware | cold wall-clock | warm wall-clock | "
+            "final valid AUC |",
+            "|---|---|---|---|---|",
+            "| reference CLI (`/tmp/refbuild/lightgbm`) | %d-core CPU "
+            "(this box) | %.1f s | %.1f s | %.6f |"
+            % (threads, rd_cold, rd_warm, ra[-1][1] if ra else -1),
+            "| lightgbm_tpu CLI | 1x TPU v5e | %.1f s | %.1f s | %.6f |"
+            % (td_cold, td_warm, ta[-1][1] if ta else -1),
+            "",
+            "AUC by iteration (valid set):",
+            "",
+            "| iteration | reference | lightgbm_tpu | delta |",
+            "|---|---|---|---|",
+        ]
+        worst = 0.0
+        for it in common:
+            d = ta_d[it] - ra_d[it]
+            worst = max(worst, abs(d))
+            lines.append("| %d | %.6f | %.6f | %+0.6f |"
+                         % (it, ra_d[it], ta_d[it], d))
+        worst_all = max(worst_all, worst)
+        lines += [
+            "",
+            "Worst AUC delta over the trajectory: **%.2e** (the "
+            "reference's own GPU-vs-CPU comparisons treat ~1e-3 as "
+            "equivalent, docs/GPU-Performance.rst:134-158)." % worst,
+        ]
     lines += [
-        "",
-        "Worst AUC delta over the trajectory: **%.2e** (the reference's own "
-        "GPU-vs-CPU comparisons treat ~1e-3 as equivalent, "
-        "docs/GPU-Performance.rst:134-158)." % worst,
         "",
         "Wall-clock caveat: this box exposes ONE CPU core; the reference's "
         "published Higgs CPU baseline (238.5 s, BASELINE.md) used 2x "
         "E5-2670v3 and remains the throughput denominator for bench.py. "
         "Cold TPU time includes XLA/Mosaic compilation; warm is the "
-        "steady-state CLI cost a user pays on every run after the first.",
+        "steady-state CLI cost a user pays on every run after the first. "
+        "The small-window regime (100k + 1M rows, `--regime small`) is "
+        "the round-7 acceptance measurement for the size-bucketed split "
+        "kernels (PERF.md BENCH_r07): at num_leaves=255 most splits there "
+        "sit below one 4096-row chunk.",
     ]
     with open(os.path.join(REPO, "HEADTOHEAD.md"), "w") as fh:
         fh.write("\n".join(lines) + "\n")
-    print("wrote HEADTOHEAD.md (worst delta %.2e)" % worst)
+    print("wrote HEADTOHEAD.md (worst delta %.2e)" % worst_all)
 
 
 if __name__ == "__main__":

@@ -27,9 +27,15 @@ VARIANTS = [
 
 
 def main():
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="fused-kernel phase attribution via dbg_skip knockouts "
+                    "(device timing from xplane; outputs are wrong)")
+    ap.add_argument("rows", nargs="?", type=int, default=2 ** 21)
+    args = ap.parse_args()
     from lightgbm_tpu.core.partition import CHUNK, partition_hist_pallas
 
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2 ** 21  # 2M rows
+    n = args.rows
     W = 128
     B = 64
     f = 28

@@ -108,6 +108,9 @@ def conv_bitcast_unpack(x_ref, o_ref):
 
 
 def main():
+    import argparse
+    argparse.ArgumentParser(
+        description="v5e u8-tile conversion microbenchmark").parse_args()
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randint(0, 255, size=(ROWS, 128)), jnp.uint8)
     print("v5e u8-tile conversion microbenchmark ([%d, 128] tiles)" % ROWS)

@@ -134,6 +134,10 @@ def dot_place(a_ref, b_ref, o_ref):
 
 
 def main():
+    import argparse
+    argparse.ArgumentParser(
+        description="v5e split-pass dot-shape microbenchmark (ns per data "
+                    "row per isolated MXU shape)").parse_args()
     print("v5e split-pass dot shapes (ns per data row)")
     _bench("extract [2,W]@[CHUNK,W]T", dot_extract_T,
            [(2, W), (CHUNK, W)], CHUNK)

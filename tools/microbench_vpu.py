@@ -161,6 +161,10 @@ def select_i32(x_ref, o_ref):
 
 
 def main():
+    import argparse
+    argparse.ArgumentParser(
+        description="v5e VPU one-hot build microbenchmark (compare/select "
+                    "chains at different dtypes)").parse_args()
     rng = np.random.RandomState(0)
     xi = rng.randint(0, 64, size=(ROWS, 128))
     print("v5e VPU one-hot build microbenchmark  (%d lane-ops per variant)"

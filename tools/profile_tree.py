@@ -58,6 +58,19 @@ def aggregate_xplane(trace_dir: str, top: int = 25):
 
 
 def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="profile one tree build (or --chunk: a fused boosting "
+                    "chunk) and aggregate device time from xplane")
+    ap.add_argument("rows", nargs="?", type=int, default=1_000_000)
+    ap.add_argument("leaves", nargs="?", type=int, default=255)
+    ap.add_argument("max_bin", nargs="?", type=int, default=63)
+    ap.add_argument("--chunk", action="store_true",
+                    help="profile the fused train_chunk path instead")
+    ap.add_argument("--nsrow", action="store_true",
+                    help="also print per-op device time per logical "
+                         "row-visit (PERF.md per-phase unit)")
+    cli = ap.parse_args()
     import jax
     import jax.numpy as jnp
     from lightgbm_tpu.config import Config
@@ -65,11 +78,10 @@ def main() -> None:
     from lightgbm_tpu.utils.log import Log
 
     Log.reset_level(30)
-    args = [a for a in sys.argv[1:] if not a.startswith("-")]
-    chunk = "--chunk" in sys.argv
-    n = int(args[0]) if args else 1_000_000
-    leaves = int(args[1]) if len(args) > 1 else 255
-    max_bin = int(args[2]) if len(args) > 2 else 63
+    chunk = cli.chunk
+    n = cli.rows
+    leaves = cli.leaves
+    max_bin = cli.max_bin
 
     rng = np.random.RandomState(0)
     X = rng.normal(size=(n, 28)).astype(np.float32)
@@ -118,7 +130,7 @@ def main() -> None:
     # trained tree (every row passes one window per level) — the same
     # accounting bench.py uses for device_util.
     visits = None
-    if "--nsrow" in sys.argv:
+    if cli.nsrow:
         if chunk:
             trees = b.models[-3:]
             visits = 0.0

@@ -108,4 +108,9 @@ def main(workdir):
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/lgbm_tpu_swig_smoke")
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="build the SWIG wrapper against the cffi C API and run "
+                    "a train/predict smoke test")
+    ap.add_argument("workdir", nargs="?", default="/tmp/lgbm_tpu_swig_smoke")
+    main(ap.parse_args().workdir)
