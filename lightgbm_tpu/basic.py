@@ -493,6 +493,28 @@ class Booster:
                                      num_iteration=num_iteration,
                                      start_iteration=start_iteration)
 
+    def predict_binned(self, data: Dataset, start_iteration: int = 0,
+                       num_iteration: Optional[int] = None,
+                       raw_score: bool = False,
+                       pred_leaf: bool = False) -> np.ndarray:
+        """Predict straight from a constructed ``Dataset``'s binned row
+        store (core/predict_fused.py binned fast path): integer compares
+        against prebinned thresholds, no raw-value gather/NaN pipeline.
+        The Dataset must share this booster's training bin mappers
+        (constructed with ``reference=`` or being the training set)."""
+        if not isinstance(data, Dataset):
+            raise TypeError("predict_binned wants a Dataset instance; use "
+                            "predict() for raw feature matrices")
+        data.construct()
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if pred_leaf:
+            return self._booster.predict_leaf_index_binned(data.handle,
+                                                           num_iteration)
+        return self._booster.predict_binned(data.handle, raw_score=raw_score,
+                                            num_iteration=num_iteration,
+                                            start_iteration=start_iteration)
+
     # ---- model IO ----
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,

@@ -200,6 +200,7 @@ class GBDT:
         """Bump the model generation: any in-place tree surgery (refit, leaf
         edits, shuffles, rollback) must not serve stale stacked predictions."""
         self._stacked_pred = None
+        self._fused_pred = {}
         self._model_gen = getattr(self, "_model_gen", 0) + 1
 
     @models.setter
@@ -379,10 +380,21 @@ class GBDT:
             "bins": jnp.asarray(self.learner.valid_bins(valid_data)),
             "metrics": list(metrics), "score": score,
         })
-        # replay existing model onto the new validation set
-        for i, tree in enumerate(self.models):
-            k = i % self.num_tree_per_iteration
-            self._add_tree_score_valid(-1, tree, k, vs=self.valid_sets[-1])
+        # replay existing model onto the new validation set: ONE blocked
+        # binned pass per class (core/predict_fused.py) instead of a
+        # per-tree route_binned dispatch.  The in-scan f32 add order equals
+        # the per-tree loop's, so the result is bit-identical when the
+        # score base is zero; with a nonzero init_score the base joins the
+        # sum last instead of first (ULP-level association difference)
+        models = self.models
+        if models:
+            K = self.num_tree_per_iteration
+            vs = self.valid_sets[-1]
+            scores = self.raw_predict_binned(valid_data,
+                                             use_early_stop=False)
+            for k in range(K):
+                vs["score"] = vs["score"].at[k].add(
+                    jnp.asarray(scores[k], dtype=jnp.float32))
 
     # ---- scores ----
 
@@ -1278,9 +1290,38 @@ class GBDT:
         return -1.0, 10
 
     def _use_device_predict(self, models: List[Tree], n: int) -> bool:
-        from ..core.predict import has_categorical_splits
-        return (n >= self._DEVICE_PREDICT_MIN_ROWS and len(models) > 0
-                and not has_categorical_splits(models))
+        # categorical models ride the device path too since the fused
+        # predictor's bitset decide (core/predict.py decide_raw)
+        return n >= self._DEVICE_PREDICT_MIN_ROWS and len(models) > 0
+
+    def _fused_predictor(self, sel: List[Tree], start: int, end: int,
+                         class_id: int, kind: str = "raw", layout_ds=None):
+        """EnsembleArrays-keyed predictor cache: the stacked blocked device
+        ensemble for one (model range, class, generation, kind) is built
+        once and reused by every subsequent predict/eval/refit call."""
+        from ..core.predict_fused import FusedPredictor
+        if kind == "binned" and layout_ds is None:
+            layout_ds = self.train_data
+        key = (kind, start, end, class_id, len(self._models),
+               getattr(self, "_model_gen", 0),
+               id(layout_ds) if kind == "binned" else 0)
+        cache = getattr(self, "_fused_pred", None)
+        if cache is None:
+            cache = self._fused_pred = {}
+        pred = cache.get(key)
+        if pred is None:
+            if len(cache) >= 8:
+                # predict-during-training churns the model range every
+                # iteration; drop the oldest stacked ensembles instead of
+                # holding every generation's device arrays alive
+                cache.pop(next(iter(cache)))
+            pred = FusedPredictor(sel, dataset=layout_ds, kind=kind)
+            cache[key] = pred
+        return pred
+
+    def _sharded_predict_eligible(self) -> bool:
+        return (self.mesh is not None
+                and int(np.prod(self.mesh.devices.shape)) > 1)
 
     def _raw_predict(self, X: np.ndarray, num_iteration: int = -1,
                      start_iteration: int = 0) -> np.ndarray:
@@ -1293,11 +1334,19 @@ class GBDT:
         sel = self.models[start_iteration * K:end_iter * K]
         margin, freq = self._predict_early_stop()
         if self._use_device_predict(sel, n):
-            from ..core.predict import predict_device
+            sharded = self._sharded_predict_eligible()
             for k in range(K):
-                out[k] = predict_device(sel[k::K], X,
-                                        early_stop_margin=margin,
-                                        round_period=freq)
+                pred = self._fused_predictor(sel[k::K], start_iteration,
+                                             end_iter, k)
+                if sharded:
+                    from ..parallel.learners import sharded_predict
+                    out[k] = sharded_predict(
+                        pred.ens, np.asarray(X, dtype=np.float32),
+                        self.mesh, early_stop_margin=margin,
+                        round_period=freq)
+                else:
+                    out[k] = pred(X, early_stop_margin=margin,
+                                  round_period=freq)
             return out
         if margin < 0 and len(sel) > 0:
             # cached flat-array ensemble: the reference's SingleRowPredictor
@@ -1359,15 +1408,98 @@ class GBDT:
         end = total_iter if num_iteration <= 0 else min(total_iter, num_iteration)
         sel = self.models[:end * K]
         if self._use_device_predict(sel, len(X)):
-            from ..core.predict import predict_device
-            per_class = [predict_device(sel[k::K], X, want_leaf=True)
-                         for k in range(K)]
             out = np.zeros((len(X), len(sel)), dtype=np.int32)
             for k in range(K):
-                out[:, k::K] = per_class[k]
+                pred = self._fused_predictor(sel[k::K], 0, end, k)
+                out[:, k::K] = pred(np.asarray(X, dtype=np.float32),
+                                    want_leaf=True)
             return out
         cols = [self.models[i].predict_leaf_index(X) for i in range(end * K)]
         return np.stack(cols, axis=1) if cols else np.zeros((len(X), 0), np.int32)
+
+    # ---- binned fast path (core/predict_fused.py): training-format u8 rows ----
+
+    def raw_predict_binned(self, dataset: Optional[BinnedDataset] = None,
+                           num_iteration: int = -1, start_iteration: int = 0,
+                           use_early_stop: bool = True) -> np.ndarray:
+        """[K, N] raw scores straight from a binned dataset's u8/u16 row
+        store: integer compares against host-prebinned thresholds — no f32
+        gather/NaN pipeline, 1 byte read per (row, node) instead of 4.
+
+        ``dataset`` defaults to the training data; any dataset sharing the
+        training bin mappers / EFB layout (reference-aligned valid sets,
+        subsets) routes bit-identically to the raw-value path."""
+        ds = dataset if dataset is not None else self.train_data
+        if ds is None or ds.binned is None:
+            raise ValueError("binned prediction needs a BinnedDataset with "
+                             "its row store attached")
+        K = self.num_tree_per_iteration
+        out = np.zeros((K, ds.num_data), dtype=np.float64)
+        total_iter = len(self.models) // K
+        end_iter = total_iter if num_iteration <= 0 else min(
+            total_iter, start_iteration + num_iteration)
+        sel = self.models[start_iteration * K:end_iter * K]
+        if not sel:
+            return out
+        margin, freq = ((-1.0, 10) if not use_early_stop
+                        else self._predict_early_stop())
+        layout = self.train_data if self.train_data is not None else ds
+        for k in range(K):
+            pred = self._fused_predictor(sel[k::K], start_iteration, end_iter,
+                                         k, kind="binned", layout_ds=layout)
+            out[k] = pred(ds.binned, early_stop_margin=margin,
+                          round_period=freq)
+        return out
+
+    def predict_binned(self, dataset: Optional[BinnedDataset] = None,
+                       raw_score: bool = False, num_iteration: int = -1,
+                       start_iteration: int = 0) -> np.ndarray:
+        """Like :meth:`predict` but over a binned dataset's row store."""
+        raw = self.raw_predict_binned(dataset, num_iteration, start_iteration)
+        if self.average_output:
+            total_iter = max(len(self.models) // self.num_tree_per_iteration, 1)
+            raw = raw / total_iter
+        if not raw_score and self.objective is not None:
+            raw = np.asarray(self.objective.convert_output(raw))
+        return raw[0] if self.num_tree_per_iteration == 1 else raw.T
+
+    def predict_leaf_index_binned(self, dataset: Optional[BinnedDataset] = None,
+                                  num_iteration: int = -1) -> np.ndarray:
+        """[N, num_models] leaf indices from the binned row store (the refit
+        router: gbdt.cpp:299 RefitTree without materializing raw values)."""
+        ds = dataset if dataset is not None else self.train_data
+        if ds is None or ds.binned is None:
+            raise ValueError("binned prediction needs a BinnedDataset with "
+                             "its row store attached")
+        K = self.num_tree_per_iteration
+        total_iter = len(self.models) // K
+        end = total_iter if num_iteration <= 0 else min(total_iter,
+                                                        num_iteration)
+        sel = self.models[:end * K]
+        out = np.zeros((ds.num_data, len(sel)), dtype=np.int32)
+        layout = self.train_data if self.train_data is not None else ds
+        for k in range(K):
+            pred = self._fused_predictor(sel[k::K], 0, end, k, kind="binned",
+                                         layout_ds=layout)
+            out[:, k::K] = pred(ds.binned, want_leaf=True)
+        return out
+
+    def replay_train_score(self) -> None:
+        """train_score += model(train rows) for ALL trees in ONE blocked
+        binned pass per class — the loaded-model replay (cli task=train
+        with input_model, engine.train init_model) without T per-tree
+        ``route_binned`` dispatches.  Bit-identical to the per-tree loop
+        when the score base is zero; a nonzero init_score base joins the
+        f32 sum last instead of first (ULP-level association difference)."""
+        models = self.models
+        K = self.num_tree_per_iteration
+        if not models or self.train_data is None:
+            return
+        n = self.num_data
+        scores = self.raw_predict_binned(use_early_stop=False)
+        for k in range(K):
+            self.train_score = self.train_score.at[k, :n].add(
+                jnp.asarray(scores[k], dtype=jnp.float32))
 
     # ---- feature importance (c_api.cpp:1573 semantics) ----
 

@@ -218,12 +218,20 @@ def _eval_names(cb: _CBooster) -> List[str]:
     return [n for m in cb.booster._booster.train_metrics for n in m.names]
 
 
+_PRED_EARLY_STOP_KEYS = ("pred_early_stop", "pred_early_stop_freq",
+                         "pred_early_stop_margin")
+
+
 def _predict_matrix(cb: _CBooster, mat: np.ndarray, predict_type: int,
                     num_iteration: int, parameter: str) -> np.ndarray:
     params = alias_transform(_parse_params(parameter))
     kwargs = {}
     if "start_iteration" in params:
         kwargs["start_iteration"] = int(params.pop("start_iteration"))
+    # margin-based prediction early stop rides the fused device predictor
+    # (config.h pred_early_stop*); scoped to this call, then restored
+    early_stop = {k: params.pop(k) for k in _PRED_EARLY_STOP_KEYS
+                  if k in params}
     ignored = {k: v for k, v in params.items()
                if k not in ("verbosity", "predict_raw_score",
                             "predict_leaf_index", "predict_contrib")}
@@ -232,19 +240,29 @@ def _predict_matrix(cb: _CBooster, mat: np.ndarray, predict_type: int,
                     ",".join(sorted(ignored)))
     if num_iteration < 0:
         num_iteration = None
-    if predict_type == PREDICT_LEAF_INDEX:
-        kwargs.pop("start_iteration", None)
-        out = cb.booster.predict(mat, num_iteration=num_iteration,
-                                 pred_leaf=True, **kwargs)
-    elif predict_type == PREDICT_CONTRIB:
-        kwargs.pop("start_iteration", None)
-        out = cb.booster.predict(mat, num_iteration=num_iteration,
-                                 pred_contrib=True, **kwargs)
-    elif predict_type == PREDICT_RAW_SCORE:
-        out = cb.booster.predict(mat, num_iteration=num_iteration,
-                                 raw_score=True, **kwargs)
-    else:
-        out = cb.booster.predict(mat, num_iteration=num_iteration, **kwargs)
+    cfg = cb.booster._booster.config
+    saved = {k: getattr(cfg, k) for k in early_stop}
+    if early_stop:
+        cfg.set(early_stop)
+    try:
+        if predict_type == PREDICT_LEAF_INDEX:
+            kwargs.pop("start_iteration", None)
+            out = cb.booster.predict(mat, num_iteration=num_iteration,
+                                     pred_leaf=True, **kwargs)
+        elif predict_type == PREDICT_CONTRIB:
+            kwargs.pop("start_iteration", None)
+            out = cb.booster.predict(mat, num_iteration=num_iteration,
+                                     pred_contrib=True, **kwargs)
+        elif predict_type == PREDICT_RAW_SCORE:
+            out = cb.booster.predict(mat, num_iteration=num_iteration,
+                                     raw_score=True, **kwargs)
+        else:
+            out = cb.booster.predict(mat, num_iteration=num_iteration,
+                                     **kwargs)
+    finally:
+        if early_stop:
+            cfg.set({k: (str(v).lower() if isinstance(v, bool) else str(v))
+                     for k, v in saved.items()})
     return np.ascontiguousarray(np.asarray(out, dtype=np.float64))
 
 

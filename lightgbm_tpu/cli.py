@@ -108,9 +108,9 @@ class Application:
             with open(cfg.input_model) as fh:
                 booster.load_model_from_string(fh.read())
             booster.reset_training_data(train_data, objective)
-            for i, tree in enumerate(booster.models):
-                booster._add_tree_score_train(
-                    tree, i % booster.num_tree_per_iteration)
+            # one blocked binned pass over the whole loaded model instead
+            # of a per-tree device dispatch (core/predict_fused.py)
+            booster.replay_train_score()
         if cfg.is_provide_training_metric:
             booster.add_train_metrics(create_metrics(cfg.metric, cfg))
         for i, valid_file in enumerate(cfg.valid or []):
@@ -174,10 +174,18 @@ class Application:
         with open(cfg.input_model) as fh:
             booster.load_model_from_string(fh.read())
         booster.reset_training_data(train_data, objective)
-        if train_data.raw_data is None:
-            Log.fatal("refit needs the raw feature values")
-        leaf_preds = booster.predict_leaf_index(
-            np.asarray(train_data.raw_data), -1)
+        if train_data.raw_data is not None:
+            # raw values available: route with exact v <= thr per node
+            # (reference RefitTree semantics even for externally-trained
+            # models whose thresholds are not this dataset's bin bounds)
+            leaf_preds = booster.predict_leaf_index(
+                np.asarray(train_data.raw_data), -1)
+        else:
+            # CSR-loaded datasets keep no raw matrix: route through the
+            # BINNED fast path (bit-parity with raw routing whenever the
+            # model's thresholds sit on this dataset's bin upper bounds,
+            # i.e. it was trained on these mappers)
+            leaf_preds = booster.predict_leaf_index_binned()
         booster.refit(leaf_preds)
         booster.save_model(cfg.output_model)
         Log.info("Finished refit, saved model to %s", cfg.output_model)

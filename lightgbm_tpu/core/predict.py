@@ -19,8 +19,11 @@ Margin-based prediction early stop (src/application/prediction_early_stop.cpp:26
 rides the same scan: every `round_period` trees, rows whose margin exceeds the
 threshold stop accumulating.
 
-Categorical splits fall back to the host path (bitset membership per node is
-pointer-y; categorical models route on host until this is hot).
+Categorical splits ride the same decide step: every node carries a (padded)
+left-category bitset and membership is a word select + bit test vectorized
+over (row, node) — see :func:`decide_raw` — so categorical models no longer
+route on host.  The tree-blocked engine (core/predict_fused.py) reuses this
+decide on [G, M]-shaped tree blocks.
 """
 from __future__ import annotations
 
@@ -36,11 +39,18 @@ from .tree import (K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, K_ZERO_THRESHOLD,
 
 
 class EnsembleArrays(NamedTuple):
-    """Stacked per-tree arrays, padded to common [T, M] nodes / [T, L] leaves."""
+    """Stacked per-tree arrays, padded to common [T, M] nodes / [T, L] leaves.
+
+    The tree-blocked engine (core/predict_fused.py) carries the same fields
+    reshaped to [T/G, G, ...] blocks; every consumer indexes node axes from
+    the right so both layouts share the decide/contract code."""
     split_feature: jax.Array   # [T, M] i32
     threshold: jax.Array       # [T, M] f32
     default_left: jax.Array    # [T, M] bool
     missing_type: jax.Array    # [T, M] i32
+    is_cat: jax.Array          # [T, M] bool
+    cat_bitset: jax.Array      # [T, M, W] u32 left-category bitsets (W=0
+                               # when the ensemble has no categorical splits)
     path_sign: jax.Array       # [T, M, L] f32 in {-1, 0, +1}
     path_len: jax.Array        # [T, L] f32 (#nonzero path entries; pad -1)
     leaf_value: jax.Array      # [T, L] f32
@@ -73,15 +83,23 @@ def has_categorical_splits(trees: List[Tree]) -> bool:
     return any(t.num_cat > 0 for t in trees)
 
 
-def stack_ensemble(trees: List[Tree]) -> EnsembleArrays:
-    """Host: build the stacked device arrays for a list of (same-class) trees."""
+def stack_ensemble_host(trees: List[Tree]) -> EnsembleArrays:
+    """Host: stacked NUMPY arrays for a list of (same-class) trees (the
+    tree-blocked stacker pads/reshapes these before the device transfer)."""
     t_cnt = len(trees)
     m = max(max(t.num_leaves - 1, 1) for t in trees)
     l = max(t.num_leaves for t in trees)
+    w = 0
+    for t in trees:
+        if t.num_cat > 0:
+            w = max(w, max(hi - lo for lo, hi in zip(t.cat_boundaries[:-1],
+                                                     t.cat_boundaries[1:])))
     sf = np.zeros((t_cnt, m), dtype=np.int32)
     thr = np.zeros((t_cnt, m), dtype=np.float32)
     dl = np.zeros((t_cnt, m), dtype=bool)
     mt = np.zeros((t_cnt, m), dtype=np.int32)
+    ic = np.zeros((t_cnt, m), dtype=bool)
+    cb = np.zeros((t_cnt, m, w), dtype=np.uint32)
     ps = np.zeros((t_cnt, m, l), dtype=np.float32)
     pl = np.full((t_cnt, l), -1.0, dtype=np.float32)
     lv = np.zeros((t_cnt, l), dtype=np.float32)
@@ -97,13 +115,56 @@ def stack_ensemble(trees: List[Tree]) -> EnsembleArrays:
         dt = tree.decision_type[:ni].astype(np.int32)
         dl[i, :ni] = (dt & K_DEFAULT_LEFT_MASK) != 0
         mt[i, :ni] = (dt >> 2) & 3
+        ic[i, :ni] = (dt & K_CATEGORICAL_MASK) != 0
+        for node in np.flatnonzero(ic[i, :ni]):
+            cat_idx = int(tree.threshold[node])
+            lo = tree.cat_boundaries[cat_idx]
+            hi = tree.cat_boundaries[cat_idx + 1]
+            cb[i, node, :hi - lo] = np.asarray(tree.cat_threshold[lo:hi],
+                                               dtype=np.uint32)
         ps[i], pl[i] = _path_matrix(tree, m, l)
         lv[i, :tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
-    return EnsembleArrays(
-        split_feature=jnp.asarray(sf), threshold=jnp.asarray(thr),
-        default_left=jnp.asarray(dl), missing_type=jnp.asarray(mt),
-        path_sign=jnp.asarray(ps), path_len=jnp.asarray(pl),
-        leaf_value=jnp.asarray(lv))
+    return EnsembleArrays(split_feature=sf, threshold=thr, default_left=dl,
+                          missing_type=mt, is_cat=ic, cat_bitset=cb,
+                          path_sign=ps, path_len=pl, leaf_value=lv)
+
+
+def stack_ensemble(trees: List[Tree]) -> EnsembleArrays:
+    """Host: build the stacked device arrays for a list of (same-class) trees."""
+    return EnsembleArrays(*[jnp.asarray(a) for a in stack_ensemble_host(trees)])
+
+
+def decide_raw(X: jax.Array, sf, thr, dl, mt, is_cat, cat_bits) -> jax.Array:
+    """go_left [N, *TD, M] for raw rows X [N, F]; tree arrays shaped [*TD, M]
+    (TD empty for the per-tree scan, (G,) for a tree block).
+
+    Numerical: NumericalDecision missing routing (tree.h:240-277).
+    Categorical: left-bitset membership as ONE gather over the word axis +
+    a bit test — CategoricalDecision (tree.h:283-331) vectorized over
+    (row, node), program size O(1) in the word count (same lookup shape as
+    ``tree_learner._route_left``); pad words are zero and out-of-range word
+    indices clamp to them, so out-of-range categories and NaN route right
+    exactly like the host `Tree._decide`."""
+    cols = jnp.take(X, sf, axis=1)                          # [N, *TD, M]
+    val = jnp.where(jnp.isnan(cols) & (mt != 2)[None], 0.0, cols)
+    missing = (((mt == 1)[None] & (jnp.abs(val) <= K_ZERO_THRESHOLD))
+               | ((mt == 2)[None] & jnp.isnan(val)))
+    go_left = jnp.where(missing, dl[None], val <= thr[None])
+    w = cat_bits.shape[-1]
+    if w:
+        nan_mask = jnp.isnan(cols)
+        iv = jnp.where(nan_mask, 0.0, cols).astype(jnp.int32)
+        wi = iv >> 5
+        in_range = (iv >= 0) & (wi < w)
+        word = jnp.take_along_axis(
+            cat_bits[None], jnp.clip(wi, 0, w - 1)[..., None],
+            axis=-1)[..., 0]
+        bit = (word >> (iv & 31).astype(jnp.uint32)) & jnp.uint32(1)
+        cat_left = in_range & (bit == 1)
+        # NaN goes right when the split saw NaNs (tree.h:283-287)
+        cat_left = jnp.where(nan_mask & (mt == 2)[None], False, cat_left)
+        go_left = jnp.where(is_cat[None], cat_left, go_left)
+    return go_left
 
 
 @functools.partial(jax.jit, static_argnames=("early_stop_margin",
@@ -122,12 +183,8 @@ def predict_ensemble(ens: EnsembleArrays, X: jax.Array,
 
     def tree_step(carry, tree):
         score, active, idx = carry
-        sf, thr, dl, mt, ps, plen, lv = tree
-        cols = jnp.take(X, sf, axis=1)                     # [N, M]
-        val = jnp.where(jnp.isnan(cols) & (mt != 2)[None, :], 0.0, cols)
-        missing = (((mt == 1)[None, :] & (jnp.abs(val) <= K_ZERO_THRESHOLD))
-                   | ((mt == 2)[None, :] & jnp.isnan(val)))
-        go_left = jnp.where(missing, dl[None, :], val <= thr[None, :])
+        sf, thr, dl, mt, ic, cbits, ps, plen, lv = tree
+        go_left = decide_raw(X, sf, thr, dl, mt, ic, cbits)  # [N, M]
         d = jnp.where(go_left, 1.0, -1.0).astype(jnp.float32)
         hits = jax.lax.dot_general(d, ps, (((1,), (0,)), ((), ())),
                                    preferred_element_type=jnp.float32)
@@ -238,7 +295,7 @@ class StackedTreesPredictor:
             fval = X[rows, self.sf[ti, nd]]
             mt = self.mt[ti, nd]
             val = np.where(np.isnan(fval) & (mt != 2), 0.0, fval)
-            is_missing = (((mt == 1) & (np.abs(val) <= 1e-35))
+            is_missing = (((mt == 1) & (np.abs(val) <= K_ZERO_THRESHOLD))
                           | ((mt == 2) & np.isnan(val)))
             go_left = np.where(is_missing, self.default_left[ti, nd],
                                val <= self.thr[ti, nd])

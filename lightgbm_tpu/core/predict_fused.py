@@ -1,0 +1,368 @@
+"""Fused inference engine: tree-blocked path-matrix prediction.
+
+core/predict.py's `lax.scan` runs ONE [N,M]@[M,L] contraction per tree — T
+dispatch-serialized steps for embarrassingly tree-parallel work, each far too
+small to fill the MXU.  Here G trees are stacked per scan block and one
+batched `dot_general` ([N, G, M] x [G, M, L], batched over the block axis —
+the block-diagonal form of a single [N, G*M] @ [G*M, G*L] contraction)
+replaces G steps: the scan shrinks to T/G steps of G-fold larger matmuls.
+G is chosen so a block's path matrices stay VMEM-resident
+(:func:`tree_block` — the same trace-static sizing discipline as
+``partition.fused_bucket_plan``).
+
+Three serving mechanisms ride on top:
+
+- **binned fast path** (:class:`BinnedEnsembleArrays`): when the caller holds
+  the training-format u8/u16 row store (refit, training-data scoring,
+  ``Dataset``-backed predict), ``go_left`` is an integer compare against
+  host-prebinned thresholds — the semantics of ``tree_learner._route_left``
+  (tree.h:262-331 *Inner decisions) — skipping the f32 gather/NaN pipeline
+  and reading 1 byte/feature instead of 4.
+- **bounded shape buckets**: rows pad to a fixed ladder
+  (:data:`PREDICT_BUCKETS`) instead of unbounded pow2 targets, and batches
+  beyond the largest bucket stream through it in fixed-shape chunks — so
+  steady-state serving compiles at most ``len(PREDICT_BUCKETS)`` programs per
+  model, ever.  :class:`FusedPredictor` additionally caches the stacked
+  device ensemble so repeat calls re-stack nothing.
+- **sharded batch predict** lives in ``parallel.learners.sharded_predict``
+  (rows over the mesh via shard_map; body built from :func:`scan_blocks`).
+
+Every path is BIT-exact vs the per-tree ``predict_ensemble`` scan: hits are
+small-integer f32 sums (exact in any accumulation order), ``match`` is an
+exact one-hot, so each tree contributes exactly its leaf value, and the [N]
+score accumulation + early-stop checks replay the per-tree order inside an
+unrolled per-block loop.  Pinned by tests/test_predict_fused.py the way
+tests/test_partition_buckets.py pins the split-kernel variants.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.binning import BinType, MissingType
+from .predict import (EnsembleArrays, _path_matrix, decide_raw,
+                      stack_ensemble_host)
+from .tree import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, Tree
+
+# path-matrix VMEM budget per scan block (f32 bytes) and the block-width cap;
+# the same discipline as partition.fused_bucket_plan: sizes are host-static,
+# derived only from the model shape, so the dispatch never retraces.
+BLOCK_VMEM_BYTES = 1 << 20
+BLOCK_MAX = 64
+
+# fixed row-padding ladder: any batch size compiles at most len() programs
+# per model; batches beyond the top bucket stream through it in fixed-shape
+# chunks, so steady-state serving NEVER recompiles.
+PREDICT_BUCKETS = (128, 1024, 8192, 65536, 524288)
+
+
+def tree_block(t: int, m: int, l: int) -> int:
+    """Trees per scan block: the largest count whose stacked [G, M, L] path
+    matrices fit BLOCK_VMEM_BYTES, rebalanced so the final block is not
+    ragged (T=100 at cap 32 -> 4 blocks of 25, zero pad trees)."""
+    per_tree = max(m * l * 4, 1)
+    cap = max(1, min(BLOCK_MAX, BLOCK_VMEM_BYTES // per_tree, max(t, 1)))
+    n_blocks = -(-max(t, 1) // cap)
+    return -(-max(t, 1) // n_blocks)
+
+
+def shape_bucket(n: int) -> int:
+    """Smallest ladder bucket holding ``n`` rows (top bucket for chunking)."""
+    for b in PREDICT_BUCKETS:
+        if n <= b:
+            return b
+    return PREDICT_BUCKETS[-1]
+
+
+class BinnedEnsembleArrays(NamedTuple):
+    """Stacked per-tree arrays for the binned row store, [T, M] per node
+    (or [T/G, G, M] when blocked).  Thresholds are host-prebinned; the
+    decide is ``tree_learner._route_left`` vectorized over (row, node)."""
+    column: jax.Array        # [T, M] i32 — the bin matrix (group) column
+    thr_bin: jax.Array       # [T, M] i32
+    default_left: jax.Array  # [T, M] bool
+    missing_type: jax.Array  # [T, M] i32 (io.binning.MissingType)
+    num_bin: jax.Array       # [T, M] i32 (feature bins, for unfold + NaN bin)
+    default_bin: jax.Array   # [T, M] i32
+    offset: jax.Array        # [T, M] i32 (EFB group code of feature bin 1)
+    is_cat: jax.Array        # [T, M] bool
+    cat_bitset: jax.Array    # [T, M, W] u32 left-BIN sets (W=0: no cat)
+    path_sign: jax.Array     # [T, M, L] f32
+    path_len: jax.Array      # [T, L] f32 (pad -1)
+    leaf_value: jax.Array    # [T, L] f32
+
+
+def stack_ensemble_binned_host(trees: List[Tree],
+                               dataset) -> BinnedEnsembleArrays:
+    """Host: prebin every node of ``trees`` against ``dataset``'s bin
+    mappers / EFB group layout (the per-node mapping of
+    ``gbdt._tree_to_device``, batched into stacked numpy arrays).
+
+    Any dataset sharing the training mappers (reference-aligned valid sets,
+    subsets) routes identically; thresholds land on bin upper bounds so the
+    binned decide is bit-parity with the raw-value decide on binned rows."""
+    t_cnt = len(trees)
+    m = max(max(t.num_leaves - 1, 1) for t in trees)
+    l = max(t.num_leaves for t in trees)
+    has_cat = any(t.num_cat > 0 for t in trees)
+    w = 0
+    if has_cat:
+        cat_bins = [mp.num_bin for mp in dataset.bin_mappers
+                    if mp.bin_type == BinType.CATEGORICAL]
+        w = -(-max(cat_bins, default=32) // 32)
+    col = np.zeros((t_cnt, m), dtype=np.int32)
+    thr = np.zeros((t_cnt, m), dtype=np.int32)
+    dl = np.zeros((t_cnt, m), dtype=bool)
+    mt = np.zeros((t_cnt, m), dtype=np.int32)
+    nb = np.ones((t_cnt, m), dtype=np.int32)
+    db = np.zeros((t_cnt, m), dtype=np.int32)
+    off = np.ones((t_cnt, m), dtype=np.int32)
+    ic = np.zeros((t_cnt, m), dtype=bool)
+    cb = np.zeros((t_cnt, m, w), dtype=np.uint32)
+    ps = np.zeros((t_cnt, m, l), dtype=np.float32)
+    pl = np.full((t_cnt, l), -1.0, dtype=np.float32)
+    lv = np.zeros((t_cnt, l), dtype=np.float32)
+    group_idx = dataset.group_idx
+    for i, tree in enumerate(trees):
+        ni = max(tree.num_leaves - 1, 0)
+        for node in range(ni):
+            f = int(tree.split_feature[node])
+            mapper = dataset.bin_mappers[f]
+            j = dataset.inner_feature_map[f]
+            col[i, node] = 0 if group_idx is None else int(group_idx[j])
+            off[i, node] = (1 if dataset.bin_offset is None
+                            else int(dataset.bin_offset[j]))
+            nb[i, node] = int(dataset.num_bin_per_feature[j])
+            db[i, node] = int(mapper.default_bin)
+            mt[i, node] = int(mapper.missing_type)
+            dt = int(tree.decision_type[node])
+            dl[i, node] = (dt & K_DEFAULT_LEFT_MASK) != 0
+            if dt & K_CATEGORICAL_MASK:
+                ic[i, node] = True
+                ci = int(tree.threshold[node])
+                lo = tree.cat_boundaries[ci]
+                hi = tree.cat_boundaries[ci + 1]
+                for wd in range(lo, hi):
+                    word = int(tree.cat_threshold[wd])
+                    for j2 in range(32):
+                        if (word >> j2) & 1:
+                            b = mapper.categorical_2_bin.get(
+                                (wd - lo) * 32 + j2)
+                            if b is not None:
+                                cb[i, node, b >> 5] |= np.uint32(1 << (b & 31))
+            else:
+                thr[i, node] = mapper.value_to_bin(float(tree.threshold[node]))
+        ps[i], pl[i] = _path_matrix(tree, m, l)
+        lv[i, :tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+    return BinnedEnsembleArrays(column=col, thr_bin=thr, default_left=dl,
+                                missing_type=mt, num_bin=nb, default_bin=db,
+                                offset=off, is_cat=ic, cat_bitset=cb,
+                                path_sign=ps, path_len=pl, leaf_value=lv)
+
+
+def decide_binned(B: jax.Array, ens: BinnedEnsembleArrays) -> jax.Array:
+    """go_left [N, *TD, M] for binned rows B [N, num_groups]; node arrays
+    shaped [*TD, M].  Integer compares only — ``_route_left`` +
+    ``_unfold_bin`` semantics (NumericalDecisionInner tree.h:262-277,
+    CategoricalDecisionInner :283-331: the NaN bin is never a member, so
+    missing goes right)."""
+    cols = jnp.take(B, ens.column, axis=1).astype(jnp.int32)  # [N, *TD, M]
+    off = ens.offset[None]
+    nb = ens.num_bin[None]
+    # EFB group code -> feature bin (identity for singleton groups, off=1)
+    bin_ = jnp.where((cols >= off) & (cols <= off + nb - 2),
+                     cols - off + 1, 0)
+    mt = ens.missing_type[None]
+    is_missing = jnp.where(
+        mt == int(MissingType.NAN), bin_ == nb - 1,
+        jnp.where(mt == int(MissingType.ZERO),
+                  bin_ == ens.default_bin[None], False))
+    go_left = jnp.where(is_missing, ens.default_left[None],
+                        bin_ <= ens.thr_bin[None])
+    w = ens.cat_bitset.shape[-1]
+    if w:
+        # ONE gather over the word axis (program size O(1) in w, the
+        # _route_left lookup shape); bins past the padded word range clamp
+        # to zero words, i.e. not-a-member -> right, matching the host
+        wi = bin_ >> 5
+        word = jnp.take_along_axis(
+            ens.cat_bitset[None], jnp.clip(wi, 0, w - 1)[..., None],
+            axis=-1)[..., 0]
+        bit = (word >> (bin_ & 31).astype(jnp.uint32)) & jnp.uint32(1)
+        go_left = jnp.where(ens.is_cat[None], (wi < w) & (bit == 1), go_left)
+    return go_left
+
+
+def _decide(rows: jax.Array, blk) -> jax.Array:
+    if isinstance(blk, BinnedEnsembleArrays):
+        return decide_binned(rows, blk)
+    return decide_raw(rows, blk.split_feature, blk.threshold,
+                      blk.default_left, blk.missing_type, blk.is_cat,
+                      blk.cat_bitset)
+
+
+def _block(ens, g: int):
+    """[T, ...] stacked numpy arrays -> [T/G, G, ...] blocks (pad trees are
+    dead: all-zero path columns + path_len -1 never match, leaf values 0)."""
+    t = ens.path_len.shape[0]
+    tb = -(-t // g)
+    pad = tb * g - t
+
+    def one(name, a):
+        if pad:
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            a = np.pad(a, widths, constant_values=(-1.0 if name == "path_len"
+                                                   else 0))
+        return jnp.asarray(a.reshape((tb, g) + a.shape[1:]))
+
+    return type(ens)(*[one(n, a) for n, a in zip(ens._fields, ens)])
+
+
+def stack_ensemble_blocked(trees: List[Tree],
+                           g: Optional[int] = None) -> EnsembleArrays:
+    """Raw-feature blocked device ensemble ([T/G, G, ...] fields)."""
+    host = stack_ensemble_host(trees)
+    m, l = host.path_sign.shape[1], host.path_sign.shape[2]
+    return _block(host, g or tree_block(len(trees), m, l))
+
+
+def stack_ensemble_binned_blocked(trees: List[Tree], dataset,
+                                  g: Optional[int] = None
+                                  ) -> BinnedEnsembleArrays:
+    """Binned blocked device ensemble ([T/G, G, ...] fields)."""
+    host = stack_ensemble_binned_host(trees, dataset)
+    m, l = host.path_sign.shape[1], host.path_sign.shape[2]
+    return _block(host, g or tree_block(len(trees), m, l))
+
+
+def scan_blocks(blocks, rows: jax.Array, *, early_stop_margin: float = -1.0,
+                round_period: int = 10, want_leaf: bool = False):
+    """The tree-blocked predict core (traceable; jitted wrappers below).
+
+    One scan step per G-tree block: a shared decide, ONE batched
+    [N, G, M] x [G, M, L] contraction, an exact one-hot match, then an
+    unrolled per-tree accumulate that replays the per-tree scan's f32 add
+    order and early-stop check positions bit-exactly (margin-based
+    prediction early stop, prediction_early_stop.cpp:26-65)."""
+    n = rows.shape[0]
+    g = blocks.path_len.shape[1]
+
+    def block_step(carry, blk):
+        score, active, idx = carry
+        go_left = _decide(rows, blk)                        # [N, G, M]
+        d = jnp.where(go_left, 1.0, -1.0).astype(jnp.float32)
+        hits = jax.lax.dot_general(
+            d, blk.path_sign, (((2,), (1,)), ((1,), (0,))),
+            preferred_element_type=jnp.float32)             # [G, N, L]
+        match = (hits == blk.path_len[:, None, :]).astype(jnp.float32)
+        contrib = jax.lax.dot_general(
+            match, blk.leaf_value, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)             # [G, N]
+        for j in range(g):
+            score = score + jnp.where(active, contrib[j], 0.0)
+            if early_stop_margin >= 0:
+                check = (idx + j + 1) % round_period == 0
+                active = active & jnp.where(
+                    check, 2.0 * jnp.abs(score) < early_stop_margin, True)
+        if want_leaf:
+            leaf = jnp.argmax(match, axis=2).astype(jnp.int32)  # [G, N]
+            return (score, active, idx + g), leaf
+        return (score, active, idx + g), None
+
+    init = (jnp.zeros((n,), jnp.float32), jnp.ones((n,), bool), jnp.int32(0))
+    (score, _, _), leaves = jax.lax.scan(block_step, init, blocks)
+    if want_leaf:
+        return score, jnp.transpose(leaves, (2, 0, 1)).reshape(n, -1)
+    return score
+
+
+@functools.partial(jax.jit, static_argnames=("early_stop_margin",
+                                             "round_period", "want_leaf"))
+def predict_blocked(blocks, rows, early_stop_margin: float = -1.0,
+                    round_period: int = 10, want_leaf: bool = False):
+    """Jitted tree-blocked predict over a raw [N, F] f32 chunk or a binned
+    [N, num_groups] u8/u16 chunk (dispatch on the ensemble type)."""
+    return scan_blocks(blocks, rows, early_stop_margin=early_stop_margin,
+                       round_period=round_period, want_leaf=want_leaf)
+
+
+def predict_compile_count() -> int:
+    """Compiled-program count of the bucketed dispatch (the no-recompile
+    serving contract is pinned against this going flat)."""
+    return predict_blocked._cache_size()
+
+
+class FusedPredictor:
+    """Device predictor for one class's tree sequence, stacked ONCE.
+
+    The serving counterpart of the reference's cached ``SingleRowPredictor``
+    (c_api.cpp:52-98), keyed by the boosters' ``EnsembleArrays`` identity:
+    GBDT caches instances per (model range, generation, kind), so the hot
+    path is pad-to-bucket + one cached-executable call."""
+
+    def __init__(self, trees: List[Tree], dataset=None,
+                 kind: str = "raw") -> None:
+        if kind not in ("raw", "binned"):
+            raise ValueError("kind must be 'raw' or 'binned'")
+        if kind == "binned" and dataset is None:
+            raise ValueError("binned predictor needs the training dataset "
+                             "layout (bin mappers + EFB groups)")
+        self.kind = kind
+        self.n_trees = len(trees)
+        # keep the layout dataset alive: GBDT's predictor cache keys on
+        # id(dataset), which must not be recycled while this entry lives
+        self.layout_ds = dataset
+        if kind == "raw":
+            self.ens = stack_ensemble_blocked(trees) if trees else None
+        else:
+            self.ens = (stack_ensemble_binned_blocked(trees, dataset)
+                        if trees else None)
+
+    def _prep_rows(self, X) -> np.ndarray:
+        if self.kind == "raw":
+            return np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+        X = np.ascontiguousarray(np.asarray(X))
+        if X.dtype not in (np.uint8, np.uint16):
+            raise TypeError("binned predictor wants the u8/u16 row store, "
+                            "got %s" % X.dtype)
+        return X
+
+    def __call__(self, X, early_stop_margin: float = -1.0,
+                 round_period: int = 10, want_leaf: bool = False):
+        """[N] f64 raw scores (or [N, T] i32 leaf indices with want_leaf).
+
+        Rows pad to the bucket ladder; batches beyond the top bucket stream
+        through it in fixed-shape chunks (rows are independent, so early
+        stop and leaves are chunk-local)."""
+        n = len(X)
+        if self.n_trees == 0 or n == 0:
+            if want_leaf:
+                return np.zeros((n, self.n_trees), dtype=np.int32)
+            return np.zeros(n, dtype=np.float64)
+        X = self._prep_rows(X)
+        top = PREDICT_BUCKETS[-1]
+        scores = np.empty(n, dtype=np.float64)
+        leaves = (np.empty((n, self.n_trees), dtype=np.int32)
+                  if want_leaf else None)
+        for lo in range(0, n, top):
+            chunk = X[lo:lo + top]
+            nc = len(chunk)
+            bucket = shape_bucket(nc)
+            if bucket > nc:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((bucket - nc,) + chunk.shape[1:],
+                                     dtype=chunk.dtype)])
+            out = predict_blocked(self.ens, jnp.asarray(chunk),
+                                  early_stop_margin=float(early_stop_margin),
+                                  round_period=int(round_period),
+                                  want_leaf=want_leaf)
+            if want_leaf:
+                leaves[lo:lo + nc] = np.asarray(
+                    out[1][:nc, :self.n_trees], dtype=np.int32)
+            else:
+                scores[lo:lo + nc] = np.asarray(out[:nc], dtype=np.float64)
+        return leaves if want_leaf else scores

@@ -76,10 +76,9 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         booster._booster.load_model_from_string(model_str)
         booster._booster.reset_training_data(train_set.handle,
                                              booster._booster.objective)
-        # replay the loaded model onto the training scores
-        for i, tree in enumerate(booster._booster.models):
-            booster._booster._add_tree_score_train(
-                tree, i % booster._booster.num_tree_per_iteration)
+        # replay the loaded model onto the training scores in one blocked
+        # binned pass (core/predict_fused.py) instead of per-tree dispatches
+        booster._booster.replay_train_score()
     init_iteration = booster._booster.num_init_iteration
 
     if valid_sets is not None:

@@ -8,10 +8,11 @@ from .learners import (DataParallelTreeLearner,
                        FeatureParallelTreeLearner,
                        PartitionedDataParallelTreeLearner,
                        VotingParallelTreeLearner, create_tree_learner,
-                       default_mesh)
+                       default_mesh, sharded_predict, sharded_predict_fn)
 
 __all__ = [
     "DataParallelTreeLearner",
     "FeatureParallelTreeLearner", "PartitionedDataParallelTreeLearner",
     "VotingParallelTreeLearner", "create_tree_learner", "default_mesh",
+    "sharded_predict", "sharded_predict_fn",
 ]

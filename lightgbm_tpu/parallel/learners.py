@@ -55,6 +55,72 @@ def default_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+# ---- sharded batch prediction (core/predict_fused.py over the mesh) ----
+
+_SHARDED_PREDICT_FNS: dict = {}
+
+
+def sharded_predict_fn(mesh: Mesh, early_stop_margin: float = -1.0,
+                       round_period: int = 10):
+    """Compiled sharded batch-predict: rows split over the mesh, the blocked
+    ensemble replicated, each shard running the tree-blocked scan on its
+    n/d rows.  The ONLY cross-device op is the final tiled ``all_gather``
+    of the per-shard scores — pinned on the lowered HLO by
+    tests/test_predict_fused.py.  Cached per (mesh, early-stop config);
+    jit caches per (ensemble, row-bucket) shape under that."""
+    key = (mesh, float(early_stop_margin), int(round_period))
+    fn = _SHARDED_PREDICT_FNS.get(key)
+    if fn is None:
+        from ..core.predict_fused import scan_blocks
+        axis = mesh.axis_names[0]
+
+        def body(ens, rows):
+            score = scan_blocks(ens, rows,
+                                early_stop_margin=float(early_stop_margin),
+                                round_period=int(round_period))
+            return jax.lax.all_gather(score, axis, tiled=True)
+
+        fn = jax.jit(_shard_map(body, mesh=mesh,
+                                in_specs=(P(), P(axis, None)),
+                                out_specs=P()))
+        _SHARDED_PREDICT_FNS[key] = fn
+    return fn
+
+
+def sharded_predict(ens, rows: np.ndarray, mesh: Optional[Mesh] = None, *,
+                    early_stop_margin: float = -1.0,
+                    round_period: int = 10) -> np.ndarray:
+    """[N] f64 raw scores for ``rows`` sharded over ``mesh``.
+
+    ``ens`` is a blocked (raw or binned) ensemble from core/predict_fused;
+    ``rows`` is the matching [N, F] f32 / [N, num_groups] u8 matrix.  Rows
+    pad so each shard holds a fixed bucket from the serving ladder
+    (``shape_bucket``); batches beyond the top bucket stream through it in
+    fixed-shape chunks (rows are independent), keeping the no-recompile
+    contract per shard at ANY batch size."""
+    from ..core.predict_fused import PREDICT_BUCKETS, shape_bucket
+    mesh = mesh if mesh is not None else default_mesh()
+    d = int(np.prod(mesh.devices.shape))
+    rows = np.asarray(rows)
+    if rows.dtype.kind == "f":
+        rows = rows.astype(np.float32, copy=False)
+    n = rows.shape[0]
+    fn = sharded_predict_fn(mesh, early_stop_margin, round_period)
+    top = PREDICT_BUCKETS[-1] * d
+    scores = np.empty(n, dtype=np.float64)
+    for lo in range(0, max(n, 1), top):
+        chunk = rows[lo:lo + top]
+        nc = len(chunk)
+        n_pad = shape_bucket(-(-nc // d)) * d
+        if n_pad > nc:
+            chunk = np.concatenate(
+                [chunk, np.zeros((n_pad - nc,) + chunk.shape[1:],
+                                 dtype=chunk.dtype)])
+        out = fn(ens, jnp.asarray(chunk))
+        scores[lo:lo + nc] = np.asarray(out[:nc], dtype=np.float64)
+    return scores
+
+
 class _ParallelTreeLearner(SerialTreeLearner):
     """Shared host wrapper: padding to mesh-divisible shapes + shard_map build."""
 

@@ -1,0 +1,232 @@
+"""Fused inference engine (core/predict_fused.py): every serving path —
+tree-blocked contraction, binned fast path, shape buckets, sharded predict —
+pinned BIT-exact against the per-tree ``predict_ensemble`` scan in CPU mode,
+the way tests/test_partition_buckets.py pins the split-kernel variants, plus
+the no-recompile and sharded-HLO serving contracts."""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.predict import predict_ensemble, stack_ensemble
+from lightgbm_tpu.core.predict_fused import (PREDICT_BUCKETS, FusedPredictor,
+                                             predict_compile_count,
+                                             shape_bucket, tree_block)
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+from lightgbm_tpu.parallel import default_mesh, sharded_predict, \
+    sharded_predict_fn
+
+
+@pytest.fixture(scope="module")
+def booster():
+    rng = np.random.RandomState(7)
+    n = 4000
+    X = rng.normal(size=(n, 9)).astype(np.float32)
+    X[rng.uniform(size=X.shape) < 0.05] = np.nan   # exercise missing routing
+    y = (np.nan_to_num(X[:, 0]) + 0.4 * np.nan_to_num(X[:, 1])
+         + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63)
+    cfg = Config(objective="binary", num_leaves=31, num_iterations=23,
+                 learning_rate=0.2, max_bin=63)
+    b = GBDT(cfg, ds, create_objective("binary", cfg))
+    for _ in range(23):
+        b.train_one_iter()
+    return b, X, ds
+
+
+def _scan_ref(trees, X, **kw):
+    ens = stack_ensemble(trees)
+    return predict_ensemble(ens, jnp.asarray(X, jnp.float32), **kw)
+
+
+def test_tree_block_sizing():
+    # T=100 under the 64-tree cap rebalances to 2 x 50 (zero pad trees)
+    assert tree_block(100, 30, 31) == 50
+    assert tree_block(130, 30, 31) == 44       # 3 blocks, 2 pad trees
+    # big path matrices shrink the block to the VMEM budget
+    assert tree_block(100, 254, 255, ) * 254 * 255 * 4 <= (1 << 20)
+    # huge path matrices force small blocks, floor 1
+    assert tree_block(10, 1024, 1025) == 1
+    # tiny ensembles are one block
+    assert tree_block(3, 14, 15) == 3
+    assert shape_bucket(1) == PREDICT_BUCKETS[0]
+    assert shape_bucket(PREDICT_BUCKETS[-1] + 1) == PREDICT_BUCKETS[-1]
+
+
+@pytest.mark.parametrize("n", [PREDICT_BUCKETS[0] - 1, PREDICT_BUCKETS[0],
+                               PREDICT_BUCKETS[0] + 1])
+def test_pad_boundary_parity(booster, n):
+    """N at bucket-1 / bucket / bucket+1: the padded rows never leak into
+    real outputs and the blocked path stays bit-exact vs the scan."""
+    b, X, _ = booster
+    Xq = X[:n]
+    ref = np.asarray(_scan_ref(b.models, Xq))
+    got = FusedPredictor(b.models)(Xq)
+    np.testing.assert_array_equal(ref.astype(np.float64), got)
+
+
+def test_want_leaf_and_early_stop_blocked(booster):
+    b, X, _ = booster
+    fp = FusedPredictor(b.models)
+    _, leaves = _scan_ref(b.models, X, want_leaf=True)
+    np.testing.assert_array_equal(np.asarray(leaves),
+                                  fp(X, want_leaf=True))
+    # early stop margins checked every round_period trees, including a
+    # period that does NOT divide the block width
+    g = fp.ens.path_len.shape[1]
+    for period in (3, 7, max(g - 1, 1)):
+        ref = np.asarray(_scan_ref(b.models, X, early_stop_margin=0.5,
+                                   round_period=period))
+        got = fp(X, early_stop_margin=0.5, round_period=period)
+        np.testing.assert_array_equal(ref.astype(np.float64), got)
+        assert not np.array_equal(
+            got, fp(X)), "margin 0.5 must actually truncate some rows"
+
+
+def test_binned_vs_raw_bit_parity(booster):
+    """Training-data rows route bit-identically through the u8 binned decide
+    and the f32 raw decide (thresholds sit on bin upper bounds)."""
+    b, X, ds = booster
+    raw = FusedPredictor(b.models)(X)
+    binned = FusedPredictor(b.models, dataset=ds, kind="binned")(ds.binned)
+    np.testing.assert_array_equal(raw, binned)
+    # leaf indices too (the refit router)
+    lr = FusedPredictor(b.models)(X, want_leaf=True)
+    lb = FusedPredictor(b.models, dataset=ds, kind="binned")(ds.binned,
+                                                             want_leaf=True)
+    np.testing.assert_array_equal(lr, lb)
+
+
+def test_booster_binned_entry_points(booster):
+    b, X, ds = booster
+    np.testing.assert_array_equal(b.predict(X, raw_score=True),
+                                  b.predict_binned(raw_score=True))
+    np.testing.assert_array_equal(b.predict_leaf_index(X),
+                                  b.predict_leaf_index_binned())
+
+
+def test_no_recompile_steady_state(booster):
+    """Serving contract: repeated predicts at ANY fixed batch size hit the
+    jit cache after the first call per bucket (fixed ladder, no unbounded
+    pow2 shapes)."""
+    b, X, _ = booster
+    fp = FusedPredictor(b.models)
+    fp(X[:300])                                   # warm the 1024 bucket
+    fp(X[:90])                                    # warm the 128 bucket
+    base = predict_compile_count()
+    for n in (300, 300, 700, 1024, 90, 128, 33, 512):
+        fp(X[:n])
+    assert predict_compile_count() == base, \
+        "steady-state batch sizes inside warmed buckets must not recompile"
+
+
+def test_categorical_parity_golden():
+    """Categorical model rides the device path end to end: blocked raw,
+    blocked binned, and the per-tree scan all match the host traversal on
+    in-range, unseen and NaN categories."""
+    rng = np.random.RandomState(0)
+    n, n_cats = 3000, 40
+    cat = rng.randint(0, n_cats, size=n)
+    y = np.isin(cat, [0, 3, 7, 33]) * 3.0 + rng.normal(scale=0.2, size=n)
+    X = np.column_stack([cat.astype(np.float64), rng.normal(size=n)])
+    ds = BinnedDataset.from_matrix(X, label=y, categorical_feature=[0])
+    cfg = Config(objective="regression", num_leaves=7, min_data_per_group=10,
+                 cat_smooth=1.0, max_cat_to_onehot=4, num_iterations=15)
+    b = GBDT(cfg, ds, create_objective("regression", cfg))
+    for _ in range(15):
+        b.train_one_iter()
+    assert any(t.num_cat > 0 for t in b.models), "no categorical split grown"
+    Xq = np.concatenate([X, [[99.0, 0.0], [np.nan, 0.0], [-3.0, 0.0]]])
+    host = np.zeros(len(Xq))
+    for t in b.models:
+        host += t.predict(Xq)
+    scan = np.asarray(_scan_ref(b.models, Xq))
+    np.testing.assert_allclose(scan, host, rtol=1e-5, atol=1e-6)
+    blocked = FusedPredictor(b.models)(Xq)
+    np.testing.assert_array_equal(scan.astype(np.float64), blocked)
+    binned = FusedPredictor(b.models, dataset=ds, kind="binned")(ds.binned)
+    np.testing.assert_array_equal(blocked[:n], binned)
+    # the booster-level device path now accepts categorical models
+    assert b._use_device_predict(b.models, 4096)
+    np.testing.assert_allclose(b.predict(Xq, raw_score=True), host,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_predict_bitexact(booster):
+    b, X, _ = booster
+    fp = FusedPredictor(b.models)
+    mesh = default_mesh(8)
+    got = sharded_predict(fp.ens, np.asarray(X, np.float32), mesh)
+    np.testing.assert_array_equal(fp(X), got)
+    # early stop shards cleanly (row-local state)
+    got_es = sharded_predict(fp.ens, np.asarray(X, np.float32), mesh,
+                             early_stop_margin=0.5, round_period=5)
+    ref_es = fp(X, early_stop_margin=0.5, round_period=5)
+    np.testing.assert_array_equal(ref_es, got_es)
+
+
+def test_sharded_hlo_contract(booster):
+    """Pinned on the lowered program: per-shard decide/contract shapes are
+    [N/d, ...] and the ONLY cross-device collective is the final result
+    all_gather."""
+    b, X, _ = booster
+    fp = FusedPredictor(b.models)
+    d = 8
+    mesh = default_mesh(d)
+    n = 1024
+    fn = sharded_predict_fn(mesh)
+    txt = fn.lower(fp.ens, jnp.zeros((n, X.shape[1]),
+                                     jnp.float32)).as_text()
+    n_ag = len(re.findall(r"stablehlo\.all_gather", txt))
+    assert n_ag == 1, "expected exactly the final result all_gather, got %d" \
+        % n_ag
+    for op in ("all_reduce", "reduce_scatter", "all_to_all",
+               "collective_permute"):
+        assert op not in txt, "unexpected cross-device op %s" % op
+    # the gather result is the full [n] score vector
+    assert re.search(r"all_gather.*tensor<%dxf32>" % n, txt, re.S)
+    # per-shard work: the decide/contract operands are [n/d, G, M] and the
+    # per-shard row slab is [n/d, F]
+    g = fp.ens.path_len.shape[1]
+    m = fp.ens.split_feature.shape[2]
+    assert "tensor<%dx%dx%dxf32>" % (n // d, g, m) in txt, \
+        "per-shard decide shape [N/d, G, M] not found"
+    assert "tensor<%dx%dxf32>" % (n // d, X.shape[1]) in txt
+
+
+def test_c_api_pred_early_stop_params():
+    """The C API predict entry honors pred_early_stop* parameters (scoped
+    to the call, config restored afterwards) instead of warning-ignoring
+    them."""
+    from lightgbm_tpu.basic import Booster, Dataset
+    from lightgbm_tpu.c_api import _CBooster, _predict_matrix
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(2000, 6))
+    y = (X[:, 0] > 0).astype(float)
+    bst = Booster(params={"objective": "binary", "num_leaves": 15,
+                          "verbosity": -1},
+                  train_set=Dataset(X, label=y, params={"verbosity": -1}))
+    for _ in range(20):
+        bst.update()
+    cb = _CBooster(bst)
+    base = _predict_matrix(cb, X, 0, -1, "")
+    es = _predict_matrix(cb, X, 0, -1,
+                         "pred_early_stop=true pred_early_stop_freq=5 "
+                         "pred_early_stop_margin=0.5")
+    assert (base != es).any(), "early stop must truncate some rows"
+    assert not bool(bst._booster.config.pred_early_stop), "config restored"
+    np.testing.assert_array_equal(base, _predict_matrix(cb, X, 0, -1, ""))
+
+
+def test_refit_binned_router(booster):
+    """predict_leaf_index_binned routes every training row to the same leaf
+    as the host traversal (the refit contract)."""
+    b, X, ds = booster
+    got = b.predict_leaf_index_binned()
+    host = np.stack([t.predict_leaf_index(X) for t in b.models], axis=1)
+    np.testing.assert_array_equal(got, host)

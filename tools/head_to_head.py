@@ -19,8 +19,18 @@ per-size wall-clocks are the acceptance measurement for the round-7
 bucket schedule (PERF.md BENCH_r07), alongside the 10.5M-row throughput
 headline bench.py keeps.
 
+Round 8 adds the PREDICT head-to-head (``--predict``): both CLIs run
+``task=predict`` over the SAME 1M-row csv with the SAME model file (the
+text model format is reference-compatible, so whichever model a prior
+train run left in /tmp/h2h serves both binaries), cold/warm for the TPU
+side, plus the max |score delta| between the two outputs.  This measures
+the round-8 fused inference engine (core/predict_fused.py: tree-blocked
+contraction + shape-bucketed serving) against the reference predictor
+(src/application/predictor.hpp:29-261).
+
 Usage: python tools/head_to_head.py [--rows 1000000] [--iters 100]
        python tools/head_to_head.py --regime small   # 100k + 1M rows
+       python tools/head_to_head.py --predict        # task=predict h2h
 """
 import argparse
 import os
@@ -147,6 +157,111 @@ def run_size(rows, iters, threads, skip_ref=False, skip_tpu=False):
     return results
 
 
+PRED_CONF = """task = predict
+data = {data}
+input_model = {model}
+output_result = {out}
+num_threads = {threads}
+verbosity = 1
+"""
+
+
+def _ensure_model(rows, iters, threads, skip_ref, skip_tpu):
+    """A trained model both binaries can predict with (the text format is
+    reference-compatible); reuses whatever a prior train h2h left behind,
+    else trains ONE binary."""
+    for tag in ("lightgbm_tpu", "reference"):
+        cand = "%s/%s_%d_model.txt" % (WORK, tag, rows)
+        if os.path.exists(cand):
+            return cand
+    if not skip_tpu:
+        run_size(rows, iters, threads, skip_ref=True)
+        return "%s/lightgbm_tpu_%d_model.txt" % (WORK, rows)
+    if not skip_ref:
+        run_size(rows, iters, threads, skip_tpu=True)
+        return "%s/reference_%d_model.txt" % (WORK, rows)
+    raise SystemExit("--predict with both binaries skipped and no cached "
+                     "model in %s" % WORK)
+
+
+def run_predict(rows, iters, threads, skip_ref=False, skip_tpu=False):
+    """task=predict head-to-head over the SAME data + SAME model file;
+    returns {tag: (cold_s, warm_s)} plus the output score deltas."""
+    n_valid = max(rows // 10, 10_000)
+    gen_data(rows, n_valid)
+    model = _ensure_model(rows, iters, threads, skip_ref, skip_tpu)
+    data_path = "%s/h2h.train.%d.csv" % (WORK, rows)
+    results = {}
+    for tag, cli in (
+            ("reference", [REF_CLI]),
+            ("lightgbm_tpu", [sys.executable, "-m", "lightgbm_tpu"])):
+        if (tag == "reference" and skip_ref) or \
+                (tag == "lightgbm_tpu" and skip_tpu):
+            continue
+        conf_path = "%s/%s_pred_%d.conf" % (WORK, tag, rows)
+        out_path = "%s/%s_%d_pred.txt" % (WORK, tag, rows)
+        with open(conf_path, "w") as fh:
+            fh.write(PRED_CONF.format(data=data_path, model=model,
+                                      out=out_path, threads=threads))
+        print("predicting with %s (%d rows) ..." % (tag, rows), flush=True)
+        if tag == "lightgbm_tpu":
+            cache_dir = "%s/jax_cache" % WORK
+            import shutil
+            shutil.rmtree(cache_dir, ignore_errors=True)
+            env = {"LIGHTGBM_TPU_CACHE_DIR": cache_dir}
+            cold, _ = run_cli(cli + ["config=" + conf_path],
+                              "%s_pred_%d_cold" % (tag, rows), env)
+            warm, _ = run_cli(cli + ["config=" + conf_path],
+                              "%s_pred_%d_warm" % (tag, rows), env)
+        else:
+            cold, _ = run_cli(cli + ["config=" + conf_path],
+                              "%s_pred_%d" % (tag, rows))
+            warm = cold
+        results[tag] = (cold, warm)
+        print("  %s: cold %.1f s / warm %.1f s (%.0f rows/s warm)"
+              % (tag, cold, warm, rows / max(warm, 1e-9)), flush=True)
+    maxdiff = None
+    if len(results) == 2:
+        ref = np.loadtxt("%s/reference_%d_pred.txt" % (WORK, rows))
+        tpu = np.loadtxt("%s/lightgbm_tpu_%d_pred.txt" % (WORK, rows))
+        maxdiff = float(np.max(np.abs(ref - tpu)))
+        print("max |score delta| between binaries: %.3e" % maxdiff)
+    write_predict_section(rows, threads, results, maxdiff, model)
+    return results, maxdiff
+
+
+def write_predict_section(rows, threads, results, maxdiff, model):
+    """Append the predict head-to-head section to HEADTOHEAD.md."""
+    lines = [
+        "",
+        "## Batch predict head-to-head (`task=predict`, %d rows)" % rows,
+        "",
+        "Both binaries score the SAME %d-row csv with the SAME model file "
+        "(`%s`; the text model format is reference-compatible).  The "
+        "lightgbm_tpu side runs the round-8 fused inference engine "
+        "(tree-blocked contraction + binned/bucketed serving, "
+        "core/predict_fused.py); cold = fresh persistent-compilation "
+        "cache, warm = second identical invocation." % (rows,
+                                                        os.path.basename(model)),
+        "",
+        "| binary | cold wall-clock | warm wall-clock | warm rows/s |",
+        "|---|---|---|---|",
+    ]
+    for tag in ("reference", "lightgbm_tpu"):
+        if tag not in results:
+            continue
+        cold, warm = results[tag]
+        lines.append("| %s | %.1f s | %.1f s | %.0f |"
+                     % (tag, cold, warm, rows / max(warm, 1e-9)))
+    if maxdiff is not None:
+        lines += ["", "Max |score delta| between the two outputs: "
+                      "**%.3e**." % maxdiff]
+    path = os.path.join(REPO, "HEADTOHEAD.md")
+    with open(path, "a") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print("appended predict section to HEADTOHEAD.md")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -156,10 +271,18 @@ def main():
                     help="small = the round-7 small-window regime: 100k "
                          "AND 1M rows in one report (deep-tree leaf "
                          "windows below one chunk dominate both)")
+    ap.add_argument("--predict", action="store_true",
+                    help="task=predict head-to-head: both CLIs score the "
+                         "same csv with the same model (trains one first "
+                         "if /tmp/h2h has no cached model)")
     ap.add_argument("--skip-ref", action="store_true")
     ap.add_argument("--skip-tpu", action="store_true")
     args = ap.parse_args()
     threads = os.cpu_count()
+    if args.predict:
+        run_predict(args.rows, args.iters, threads,
+                    skip_ref=args.skip_ref, skip_tpu=args.skip_tpu)
+        return
     rows_list = ([100_000, 1_000_000] if args.regime == "small"
                  else [args.rows])
 
