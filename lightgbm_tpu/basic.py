@@ -535,6 +535,20 @@ class Booster:
         self._booster.load_model_from_string(model_str)
         return self
 
+    def save_checkpoint(self, checkpoint_prefix: str) -> "Booster":
+        """Atomically write the FULL train state (model + RNG streams +
+        score caches + early-stopping bookkeeping) to
+        ``<prefix>.ckpt_iter_<n>`` — see lightgbm_tpu/checkpoint.py."""
+        self._booster.save_checkpoint(checkpoint_prefix)
+        return self
+
+    def resume_from_checkpoint(self, checkpoint_prefix: str) -> int:
+        """Restore the newest VALID checkpoint for ``prefix`` (corrupt files
+        fall back to older ones).  The booster must have the same training
+        data and valid sets attached as the checkpointed run.  Returns the
+        restored iteration, 0 when no usable checkpoint exists."""
+        return self._booster.resume_from_checkpoint(checkpoint_prefix)
+
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> Dict:
         b = self._booster

@@ -11,6 +11,10 @@ from ..utils.log import Log
 class DART(GBDT):
     fuse_iters = False
     lazy_trees = False  # dropout shrinks/re-adds host trees every iteration
+    # dropout rescales OLD trees' leaf values in place and appends to the
+    # tree-weight history — effects the pre-chunk score/model refs cannot
+    # undo, so score corruption stops at detection (gbdt._guard_chunk_scores)
+    _prechunk_rollback_safe = False
 
     def __init__(self, config, train_data=None, objective=None, mesh=None):
         self._drop_rng = np.random.RandomState(int(config.drop_seed))
@@ -22,6 +26,24 @@ class DART(GBDT):
 
     def sub_model_name(self) -> str:
         return "tree"
+
+    def _extra_train_state(self):
+        """Dropout state a bit-exact resume needs: the drop RNG stream and
+        the per-tree weight history driving non-uniform drop probabilities
+        (dart.hpp:76-86).  Without these a resumed run drops different
+        trees and silently diverges."""
+        from ..checkpoint import encode_rng_state
+        return {"drop_rng": encode_rng_state(self._drop_rng),
+                "tree_weight": [float(w) for w in self.tree_weight],
+                "sum_weight": float(self.sum_weight)}
+
+    def _restore_extra_train_state(self, extra):
+        from ..checkpoint import decode_rng_state
+        self._drop_rng.set_state(decode_rng_state(extra["drop_rng"]))
+        self.tree_weight = [float(w) for w in extra.get("tree_weight", [])]
+        self.sum_weight = float(extra.get("sum_weight", 0.0))
+        self.drop_index = []
+        self._score_is_dropped = False
 
     def _get_gradients(self):
         # drop trees once per iteration before computing gradients (dart.hpp:76-86)

@@ -35,7 +35,8 @@ from ..parallel import create_tree_learner
 from ..io.dataset import BinnedDataset
 from ..metric.metric import Metric, create_metrics
 from ..objective import ObjectiveFunction, create_objective
-from ..utils.log import Log
+from ..utils.file_io import atomic_write
+from ..utils.log import LightGBMError, Log
 from ..utils.timer import FunctionTimer
 
 K_EPSILON = 1e-15
@@ -212,8 +213,18 @@ class GBDT:
         # stall trim can still reverse their score contributions
         self._window: Dict[int, TreeArrays] = {}
         self._nl_handles: List[Tuple[int, int, jax.Array]] = []
+        # per-iteration isfinite handles (nan_policy=raise): fetched in the
+        # same _poll_stop batch as _nl_handles, so the guard costs no sync
+        self._fin_handles: List[Tuple[int, jax.Array]] = []
         self._last_poll = 0
         self._fused_cache: Dict = {}
+        # pre-chunk state refs for the per-chunk non-finite rollback
+        # (jax arrays are immutable, so holding them is free)
+        self._prechunk: Optional[Tuple] = None
+        self._nan_rolled_back_at = -1
+        # True while _fuse_failed was set by a NaN rollback (not by a trace
+        # failure) — cleared, re-arming fusion, once a retry runs clean
+        self._nan_refused_fuse = False
 
     def _materialize_pending(self) -> None:
         idxs = sorted(self._pending)
@@ -262,9 +273,19 @@ class GBDT:
         exactly where the reference would have stopped — and undoes their score
         contributions."""
         self._last_poll = self.iter_
+        if not self._nl_handles and not self._fin_handles:
+            return False
+        fetched = jax.device_get([h for _, _, h in self._nl_handles]
+                                 + [f for _, f in self._fin_handles])
+        nls = fetched[:len(self._nl_handles)]
+        fins = fetched[len(self._nl_handles):]
+        bad = [it for (it, _), ok in zip(self._fin_handles, fins)
+               if not bool(ok)]
+        self._fin_handles = []
+        if bad:
+            self._raise_nonfinite(bad[0])
         if not self._nl_handles:
             return False
-        nls = jax.device_get([h for _, _, h in self._nl_handles])
         by_iter: Dict[int, List[int]] = {}
         first_idx: Dict[int, int] = {}
         K = self.num_tree_per_iteration
@@ -599,10 +620,21 @@ class GBDT:
             with FunctionTimer("GBDT::Boosting(dispatch)"):
                 grad, hess = self._get_gradients()
         else:
-            grad = jnp.asarray(np.asarray(gradients, dtype=np.float32)).reshape(
+            grad = np.asarray(gradients, dtype=np.float32).reshape(
                 K, self.num_data)
-            hess = jnp.asarray(np.asarray(hessians, dtype=np.float32)).reshape(
+            hess = np.asarray(hessians, dtype=np.float32).reshape(
                 K, self.num_data)
+        grad, hess, skip = self._guard_gradients(grad, hess)
+        if skip:
+            return self._skip_iteration(init_scores)
+        grad = jnp.asarray(grad)
+        hess = jnp.asarray(hess)
+        if self._nan_policy == "raise" and gradients is None:
+            # async detection: the reduction rides the device queue and is
+            # fetched in the next _poll_stop batch — no per-iteration sync
+            self._fin_handles.append(
+                (self.iter_,
+                 jnp.isfinite(grad).all() & jnp.isfinite(hess).all()))
         self._bagging(self.iter_)
         grad, hess = self._adjust_gradients_for_bagging(grad, hess)
 
@@ -904,6 +936,12 @@ class GBDT:
         True when training stopped (no more splittable leaves)."""
         if num_iters <= 0:
             return False
+        # pre-chunk state refs for the per-chunk non-finite rollback; jax
+        # arrays are immutable so holding them costs nothing
+        self._prechunk = (self.train_score,
+                          tuple(vs["score"] for vs in self.valid_sets),
+                          len(self._models), self.iter_,
+                          self.bag_mask, self.bag_data_cnt)
         if not self._can_fuse_iters():
             for _ in range(num_iters):
                 if self.train_one_iter():
@@ -969,10 +1007,15 @@ class GBDT:
             with FunctionTimer("GBDT::Boosting"):
                 grad, hess = self._get_gradients()
         else:
-            grad = jnp.asarray(np.asarray(gradients, dtype=np.float32)).reshape(
+            grad = np.asarray(gradients, dtype=np.float32).reshape(
                 self.num_tree_per_iteration, self.num_data)
-            hess = jnp.asarray(np.asarray(hessians, dtype=np.float32)).reshape(
+            hess = np.asarray(hessians, dtype=np.float32).reshape(
                 self.num_tree_per_iteration, self.num_data)
+        grad, hess, skip = self._guard_gradients(grad, hess, force_check=True)
+        if skip:
+            return self._skip_iteration(init_scores)
+        grad = jnp.asarray(grad)
+        hess = jnp.asarray(hess)
 
         with FunctionTimer("GBDT::Bagging"):
             self._bagging(self.iter_)
@@ -1037,6 +1080,308 @@ class GBDT:
     def _adjust_gradients_for_bagging(self, grad, hess):
         return grad, hess
 
+    # ---- non-finite guards (nan_policy: raise / skip_iter / clip) ----
+    #
+    # One bad batch — a poisoned label, an overflowing custom gradient —
+    # yields NaN/inf grad/hess, and every later tree inherits it through the
+    # score carry.  The guard is a cheap isfinite reduction with a policy:
+    # ``raise`` (default) fails fast naming the iteration, ``skip_iter``
+    # advances the iteration with a constant zero tree, ``clip`` sanitizes
+    # (NaN -> 0, +-inf -> +-1e35) and keeps training.  On the async lazy
+    # path the raise-policy reduction rides the _poll_stop fetch; resilient
+    # policies pay a per-iteration sync by design.  Score-level corruption
+    # on the fused path is caught per-chunk (_guard_chunk_scores) and rolled
+    # back to the pre-chunk state refs.
+
+    _NAN_CLIP = np.float32(1e35)
+    # pre-chunk score/model refs fully describe a chunk's effects; DART's
+    # in-place mutation of older trees breaks that, so it opts out of the
+    # rollback-retry and stops at detection instead
+    _prechunk_rollback_safe = True
+
+    @property
+    def _nan_policy(self) -> str:
+        return str(getattr(self.config, "nan_policy", "raise"))
+
+    @staticmethod
+    def _raise_nonfinite(iteration: int) -> None:
+        raise LightGBMError(
+            "non-finite gradients/hessians/scores at iteration %d "
+            "(nan_policy=raise); set nan_policy=skip_iter or clip to "
+            "degrade gracefully instead" % iteration)
+
+    def _drain_nonfinite_checks(self) -> None:
+        """Fetch any pending isfinite reductions (nan_policy=raise) without
+        the stall-trim poll — the end-of-training drain for paths that do
+        not finish through train() (engine.train's update loop), and for
+        the trailing < _poll_freq iterations."""
+        if not self._fin_handles:
+            return
+        fins = jax.device_get([f for _, f in self._fin_handles])
+        bad = [it for (it, _), ok in zip(self._fin_handles, fins)
+               if not bool(ok)]
+        self._fin_handles = []
+        if bad:
+            self._raise_nonfinite(bad[0])
+
+    def _guard_gradients(self, grad, hess, force_check: bool = False):
+        """(grad, hess, skip): per-iteration non-finite guard.
+
+        Host arrays (custom gradients) are always checked — the check is
+        free.  Device arrays are checked when the policy is resilient or
+        ``force_check`` (synchronous paths); under the default ``raise``
+        policy the lazy path defers to the batched _poll_stop fetch
+        instead, so the async pipeline keeps its zero-sync property."""
+        policy = self._nan_policy
+        host = isinstance(grad, np.ndarray)
+        if not host and policy == "raise" and not force_check:
+            return grad, hess, False
+        xp = np if host else jnp
+        finite = bool(xp.isfinite(grad).all()) and bool(xp.isfinite(hess).all())
+        if finite:
+            return grad, hess, False
+        if policy == "raise":
+            self._raise_nonfinite(self.iter_)
+        if policy == "skip_iter":
+            Log.warning("non-finite gradients/hessians at iteration %d; "
+                        "skipping the iteration (nan_policy=skip_iter)",
+                        self.iter_)
+            return grad, hess, True
+        Log.warning("non-finite gradients/hessians at iteration %d; "
+                    "clipping (nan_policy=clip)", self.iter_)
+        grad = xp.nan_to_num(grad, nan=0.0, posinf=self._NAN_CLIP,
+                             neginf=-self._NAN_CLIP)
+        # hessians are curvature weights: non-negative by contract
+        hess = xp.nan_to_num(hess, nan=0.0, posinf=self._NAN_CLIP, neginf=0.0)
+        return grad, hess, False
+
+    def _skip_iteration(self, init_scores: Optional[List[float]] = None
+                        ) -> bool:
+        """nan_policy=skip_iter: advance the iteration with constant trees
+        so model/iteration bookkeeping stays aligned while the scores stay
+        untouched by the bad batch.  A first-iteration skip must still
+        carry the boost_from_average offset (already added to the scores
+        before gradients were computed) into the model, or every saved
+        prediction would be shifted by it."""
+        for k in range(self.num_tree_per_iteration):
+            tree = Tree(1)
+            if init_scores is not None and len(self._models) < \
+                    self.num_tree_per_iteration:
+                tree.leaf_value[0] = init_scores[k]
+            self._models.append(tree)
+        self._last_iter_arrays = [None] * self.num_tree_per_iteration
+        self.iter_ += 1
+        return False
+
+    def _guard_chunk_scores(self) -> bool:
+        """Per-chunk isfinite reduction over the training scores (the carry
+        every future iteration reads).  Returns True when training must stop
+        at the restored last-good state; False to continue.  raise policy
+        raises.  On the first corruption with a resilient policy the chunk
+        is rolled back to the pre-chunk refs and re-run per-iteration
+        (where _guard_gradients can skip/clip the bad batch); if the same
+        chunk corrupts twice, training stops at the last good iteration.
+
+        Under the default ``raise`` policy there is no rollback to stage, so
+        the reduction rides the _poll_stop/_drain batch as a lazy handle —
+        the async pipeline keeps its zero-sync property; only the resilient
+        policies pay the per-chunk host sync their rollback needs."""
+        if self._nan_policy == "raise":
+            self._prechunk = None
+            self._fin_handles.append(
+                (self.iter_, jnp.isfinite(self.train_score).all()))
+            return False
+        if bool(jnp.isfinite(self.train_score).all()):
+            self._prechunk = None
+            if self._nan_refused_fuse:
+                # the retried window completed clean: a TRANSIENT fault is
+                # over, re-arm the fused path instead of paying per-iteration
+                # dispatch for the rest of the run.  (A persistent poison
+                # re-corrupts the next fused chunk and lands back here — one
+                # wasted dispatch per chunk, bounded by the _nan_rolled_back
+                # latch stopping a same-iteration repeat.)
+                self._fuse_failed = False
+                self._nan_refused_fuse = False
+            return False
+        if self._prechunk is None or not self._prechunk_rollback_safe:
+            # DART mutates previously committed trees in place (dropout
+            # shrink/re-add) and appends tree-weight history per iteration —
+            # state the pre-chunk refs cannot restore; stop at detection
+            # instead of pretending the rollback is clean
+            Log.warning("non-finite training scores after iteration %d with "
+                        "no clean rollback state; stopping training",
+                        self.iter_)
+            return True
+        self._restore_prechunk()
+        if self._nan_rolled_back_at == self.iter_:
+            Log.warning("non-finite scores persist at iteration %d after a "
+                        "per-iteration retry; stopping training at the last "
+                        "good state (nan_policy=%s)", self.iter_,
+                        self._nan_policy)
+            return True
+        Log.warning("non-finite training scores detected; rolled back to "
+                    "iteration %d and retrying per-iteration "
+                    "(nan_policy=%s)", self.iter_, self._nan_policy)
+        self._nan_rolled_back_at = self.iter_
+        # re-run the window with per-iteration guards; re-armed once a
+        # retried window completes clean (see above)
+        self._fuse_failed = True
+        self._nan_refused_fuse = True
+        return False
+
+    def _restore_prechunk(self) -> None:
+        """Roll state back to the refs captured at the last train_chunk
+        entry: scores, model list length, bagging window, iteration."""
+        score, vscores, n_models, it, bag_mask, bag_cnt = self._prechunk
+        self._prechunk = None
+        self.train_score = score
+        for vs, s in zip(self.valid_sets, vscores):
+            vs["score"] = s
+        for idx in [i for i in self._pending if i >= n_models]:
+            self._pending.pop(idx)
+        del self._models[n_models:]
+        self.bag_mask = bag_mask
+        self.bag_data_cnt = bag_cnt
+        self.iter_ = it
+        self._window = {i: a for i, a in self._window.items() if i < n_models}
+        self._nl_handles = [h for h in self._nl_handles if h[1] < n_models]
+        self._fin_handles = []
+        self._last_iter_arrays = []
+        self._invalidate_predict_cache()
+
+    # ---- fault-tolerant train-state checkpoints (lightgbm_tpu/checkpoint.py) ----
+
+    def capture_train_state(self):
+        """(meta, arrays, model_str): EVERYTHING future iterations read.
+
+        The model string alone loses the bagging/feature-fraction RNG
+        streams, early-stopping bookkeeping, CEGB paid-cost state and the
+        f32 score caches, so an init_model resume silently diverges; this
+        captures all of it.  Scores go as binary arrays — DART's dropout
+        makes the incremental f32 score sum order-dependent, so a replay of
+        final leaf values is NOT bit-exact (see checkpoint.py)."""
+        from ..checkpoint import encode_rng_state
+        if self._nl_handles:
+            # settle the deferred no-more-splits poll first: a stalled
+            # trailing iteration would otherwise be captured here but
+            # TRIMMED by the uninterrupted run's next poll, and the resumed
+            # run could never trim below the checkpoint — breaking
+            # bit-exactness exactly when training stalls near a boundary
+            self._poll_stop()
+        meta = {
+            "boosting": type(self).__name__.lower(),
+            "iteration": int(self.iter_),
+            "num_init_iteration": int(self.num_init_iteration),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "bag_rng": encode_rng_state(self._bag_rng),
+            "feat_rng": encode_rng_state(self._feat_rng),
+            "es_state": [[ds, name, float(cur), int(it)]
+                         for (ds, name), (cur, it)
+                         in sorted(self._es_state.items())],
+            "valid_names": [vs["name"] for vs in self.valid_sets],
+            "params": {k: str(v)
+                       for k, v in sorted(self.config.raw_params.items())},
+            "extra": self._extra_train_state(),
+        }
+        arrays = {"train_score": np.asarray(self.train_score)}
+        for i, vs in enumerate(self.valid_sets):
+            arrays["valid_score_%d" % i] = np.asarray(vs["score"])
+        ln = self.learner
+        if getattr(ln, "cegb_used", None) is not None:
+            arrays["cegb_used"] = np.asarray(ln.cegb_used)
+        if getattr(ln, "cegb_paid", None) is not None:
+            arrays["cegb_paid"] = np.asarray(ln.cegb_paid)
+        return meta, arrays, self.save_model_to_string()
+
+    def restore_train_state(self, meta, arrays, model_str) -> None:
+        """Inverse of :meth:`capture_train_state`.  Call on a booster whose
+        training data AND validation sets are already attached (scores are
+        restored positionally over ``valid_sets``); afterwards ``train()``
+        continues exactly where the checkpointed run left off."""
+        from ..checkpoint import CheckpointError, decode_rng_state
+        want = type(self).__name__.lower()
+        if meta.get("boosting") != want:
+            raise CheckpointError(
+                "checkpoint was written by boosting=%r, this booster is %r"
+                % (meta.get("boosting"), want))
+        names = list(meta.get("valid_names", []))
+        have = [vs["name"] for vs in self.valid_sets]
+        if names != have:
+            # scores are restored positionally: a different order would
+            # silently hand each valid set another one's score cache
+            raise CheckpointError(
+                "checkpoint validation sets %r do not match the attached "
+                "ones %r — attach the same valid sets in the same order "
+                "before restoring" % (names, have))
+        ts = np.asarray(arrays["train_score"])
+        if tuple(ts.shape) != tuple(self.train_score.shape):
+            raise CheckpointError(
+                "checkpoint train_score shape %r does not match this "
+                "dataset/learner layout %r — resume needs the same training "
+                "data" % (tuple(ts.shape), tuple(self.train_score.shape)))
+        # resume assumes the SAME run continuing; differing params mean a
+        # stale checkpoint or an edited command — warn loudly, don't guess
+        saved_params = meta.get("params")
+        if saved_params is not None:
+            path_keys = {"output_model", "input_model", "output_result",
+                         "config", "task"}
+            cur = {k: str(v) for k, v in self.config.raw_params.items()}
+            diff = sorted(k for k in set(saved_params) | set(cur)
+                          if k not in path_keys
+                          and saved_params.get(k) != cur.get(k))
+            if diff:
+                Log.warning(
+                    "resuming a checkpoint whose parameters differ from the "
+                    "current run (%s); the resumed model mixes both configs",
+                    ", ".join("%s: %r -> %r" % (k, saved_params.get(k),
+                                                cur.get(k)) for k in diff))
+        self.load_model_from_string(model_str)
+        # load_model_from_string treats the model as an init_model (iter_=0,
+        # num_init_iteration=total); a RESUME is the same run continuing
+        self.iter_ = int(meta["iteration"])
+        self.num_init_iteration = int(meta["num_init_iteration"])
+        self.shrinkage_rate = float(meta["shrinkage_rate"])
+        self._bag_rng.set_state(decode_rng_state(meta["bag_rng"]))
+        self._feat_rng.set_state(decode_rng_state(meta["feat_rng"]))
+        self._es_state = {(ds, name): (cur, it)
+                          for ds, name, cur, it in meta.get("es_state", [])}
+        self.train_score = jnp.asarray(ts)
+        for i, vs in enumerate(self.valid_sets):
+            vs["score"] = jnp.asarray(np.asarray(arrays["valid_score_%d" % i]))
+        ln = self.learner
+        if "cegb_used" in arrays and getattr(ln, "cegb_used", None) is not None:
+            ln.cegb_used = jnp.asarray(np.asarray(arrays["cegb_used"]))
+        if "cegb_paid" in arrays and getattr(ln, "cegb_paid", None) is not None:
+            ln.cegb_paid = jnp.asarray(np.asarray(arrays["cegb_paid"]))
+        # rebuild the bagging mask for the in-progress window: the stateless
+        # hash (_bag_uniforms) regenerates the window-start mask exactly
+        cfg = self.config
+        if cfg.bagging_freq > 0 and (self._balanced_bagging()
+                                     or float(cfg.bagging_fraction) < 1.0):
+            itw = self.iter_ - self.iter_ % int(cfg.bagging_freq)
+            GBDT._bagging(self, itw)
+        self._restore_extra_train_state(meta.get("extra") or {})
+
+    def _extra_train_state(self) -> Dict:
+        """Subclass state that must survive a resume (DART overrides)."""
+        return {}
+
+    def _restore_extra_train_state(self, extra: Dict) -> None:
+        pass
+
+    def save_checkpoint(self, prefix: str, keep: Optional[int] = None) -> str:
+        """Atomically write the full train state to
+        ``<prefix>.ckpt_iter_<iteration>`` (checkpoint.save_checkpoint)."""
+        from ..checkpoint import save_checkpoint
+        return save_checkpoint(self, prefix, keep=keep)
+
+    def resume_from_checkpoint(self, prefix: str) -> int:
+        """Restore the newest VALID checkpoint for ``prefix`` (corrupt files
+        fall back to older ones); returns the restored iteration, 0 when
+        none found."""
+        from ..checkpoint import restore_checkpoint
+        return restore_checkpoint(self, prefix)
+
     def _renew_tree_output(self, tree: Tree, arrays: TreeArrays,
                            class_id: int) -> TreeArrays:
         """Per-leaf output renewal for percentile objectives
@@ -1093,6 +1438,9 @@ class GBDT:
         self._window = {i: a for i, a in self._window.items() if i < cut}
         self._nl_handles = [h for h in self._nl_handles if h[1] < cut]
         self.iter_ -= 1
+        # the rolled-back iteration's isfinite handle must not raise later
+        self._fin_handles = [h for h in self._fin_handles
+                             if h[0] < self.iter_]
 
     def refit(self, leaf_preds: np.ndarray) -> None:
         """Refit the ensemble's leaf values on the current training data.
@@ -1158,6 +1506,7 @@ class GBDT:
         self._last_iter_arrays = []
         self._window = {}
         self._nl_handles = []
+        self._fin_handles = []
 
     def merge_from(self, other: "GBDT") -> None:
         """Append another booster's trees (c_api.cpp Booster::MergeFrom).
@@ -1216,9 +1565,20 @@ class GBDT:
             nxt = total
             if has_eval and mf > 0:
                 nxt = min(nxt, it + mf - (it % mf))
-            if snapshot_out and sf > 0:
+            if sf > 0:
+                # chunk alignment keyed to the CONFIG, not to whether a
+                # snapshot path was passed: fused scans of different lengths
+                # compile to bitwise-different programs (XLA unroll/fusion
+                # choices), so a resumed run must partition iterations into
+                # the same chunks as the uninterrupted one to stay bit-exact
                 nxt = min(nxt, it + sf - (it % sf))
             finished = self.train_chunk(min(nxt - it, chunk_cap))
+            # per-chunk non-finite guard: raise fails fast, skip_iter/clip
+            # roll back to the pre-chunk refs and retry per-iteration
+            if self._guard_chunk_scores():
+                break
+            if self.iter_ == it and not finished:
+                continue  # chunk was rolled back; re-run it per-iteration
             Log.info("%f seconds elapsed, finished iteration %d",
                      time.perf_counter() - t_start, self.iter_)
             if not finished and has_eval and mf > 0 \
@@ -1227,10 +1587,29 @@ class GBDT:
             if finished:
                 break
             if (snapshot_out and sf > 0 and self.iter_ % sf == 0):
-                path = "%s.snapshot_iter_%d" % (snapshot_out, self.iter_)
-                self.save_model(path)
+                # settle the stall poll BEFORE capturing so the checkpoint
+                # never contains iterations a later poll would trim; a trim
+                # here means training is over — snapshot the final state,
+                # then stop
+                finished = bool(self._nl_handles) and self._poll_stop()
+                self._write_snapshot(snapshot_out)
+                if finished:
+                    break
         if self._nl_handles:
             self._poll_stop()  # trim any trailing stalled iterations
+        elif self._fin_handles:
+            self._drain_nonfinite_checks()
+
+    def _write_snapshot(self, snapshot_out: str) -> None:
+        """Periodic durability point: the reference-compatible model snapshot
+        (gbdt.cpp:291-295) plus a full train-state checkpoint, both written
+        atomically, retained last-``snapshot_keep``, and only by the mesh
+        leader (d hosts must not race the same rename)."""
+        from ..parallel.learners import is_write_leader
+        if not is_write_leader(self.mesh):
+            return
+        self.save_model("%s.snapshot_iter_%d" % (snapshot_out, self.iter_))
+        self.save_checkpoint(snapshot_out)
 
     # ---- evaluation ----
 
@@ -1568,11 +1947,18 @@ class GBDT:
 
     def save_model(self, filename: str, start_iteration: int = 0,
                    num_iteration: int = -1) -> None:
-        with open(filename, "w") as fh:
-            fh.write(self.save_model_to_string(start_iteration, num_iteration))
+        # atomic (tmp + fsync + rename): a kill mid-write leaves the previous
+        # complete model file, never a truncated one
+        atomic_write(filename,
+                     self.save_model_to_string(start_iteration, num_iteration))
         Log.info("Finished writing model to file %s", filename)
 
     def load_model_from_string(self, text: str) -> None:
+        """Parse the text model format; malformed/truncated input raises a
+        ``LightGBMError`` naming the failing section instead of a cryptic
+        IndexError deep in the tree parser."""
+        if not text or not text.strip():
+            raise LightGBMError("Model file is empty")
         split_at = text.find("\nTree=")
         header = text[:split_at] if split_at >= 0 else text
         rest = text[split_at + 1:] if split_at >= 0 else ""
@@ -1581,10 +1967,19 @@ class GBDT:
             if "=" in line:
                 k, v = line.split("=", 1)
                 kv[k.strip()] = v.strip()
-        self.num_class = int(kv.get("num_class", 1))
-        self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
-        self.label_idx = int(kv.get("label_index", 0))
-        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        if split_at >= 0 and "end of trees" not in rest:
+            raise LightGBMError(
+                "Model format error: missing 'end of trees' sentinel — the "
+                "tree section is truncated")
+        try:
+            self.num_class = int(kv.get("num_class", 1))
+            self.num_tree_per_iteration = int(
+                kv.get("num_tree_per_iteration", 1))
+            self.label_idx = int(kv.get("label_index", 0))
+            self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        except ValueError as exc:
+            raise LightGBMError("Model format error: unparseable header "
+                                "field (%s)" % exc)
         self.feature_names = kv.get("feature_names", "").split()
         self.feature_infos = kv.get("feature_infos", "").split()
         self.average_output = "average_output" in header.splitlines()
@@ -1603,9 +1998,29 @@ class GBDT:
                     continue
                 block = block.split("\n", 1)[1] if "\n" in block else ""
                 if block.strip():
-                    self.models.append(Tree.from_string(block))
-        self.num_init_iteration = len(self.models) // max(
-            self.num_tree_per_iteration, 1)
+                    try:
+                        self.models.append(Tree.from_string(block))
+                    except (LightGBMError, ValueError, IndexError,
+                            KeyError) as exc:
+                        raise LightGBMError(
+                            "Model format error: Tree=%d is malformed (%s)"
+                            % (len(self.models), exc))
+        # outside the `if rest` guard: a file truncated BEFORE the first
+        # Tree= block still declares its trees in the header and must not
+        # load as a silent 0-tree model
+        declared = kv.get("tree_sizes", "").split()
+        if declared and len(declared) != len(self.models):
+            raise LightGBMError(
+                "Model format error: tree_sizes declares %d trees but "
+                "%d were parsed — the tree section is truncated"
+                % (len(declared), len(self.models)))
+        K = max(self.num_tree_per_iteration, 1)
+        if len(self.models) % K != 0:
+            raise LightGBMError(
+                "Model format error: %d trees is not a multiple of "
+                "num_tree_per_iteration=%d — the tree section is truncated"
+                % (len(self.models), K))
+        self.num_init_iteration = len(self.models) // K
         self.iter_ = 0
 
     @classmethod

@@ -29,12 +29,32 @@ class RF(GBDT):
         # RF computes init scores but never adds them to the score updater
         return super()._boost_from_average(class_id, update_scorer=False)
 
+    _init_scores_ready = False
+    _rf_guarded = False
+    _rf_skip = False
+
+    def _extra_train_state(self):
+        """The constant init scores gradients are computed against: after a
+        resume the model is non-empty, so _boost_from_average would return
+        0.0 and a recompute would silently shift every later tree."""
+        return {"init_scores": [float(s) for s in self._init_scores],
+                "init_scores_ready": bool(self._init_scores_ready)}
+
+    def _restore_extra_train_state(self, extra):
+        if "init_scores" in extra:
+            self._init_scores = [float(s) for s in extra["init_scores"]]
+            self._init_scores_ready = bool(extra.get("init_scores_ready"))
+            self._rf_grad = None
+            self._rf_guarded = False
+
     def _get_gradients(self):
         # gradients w.r.t. constant init score, computed once (rf.hpp:83-101)
         if self._rf_grad is None:
             import jax.numpy as jnp
-            for k in range(self.num_tree_per_iteration):
-                self._init_scores[k] = self._boost_from_average(k, False)
+            if not self._init_scores_ready:
+                for k in range(self.num_tree_per_iteration):
+                    self._init_scores[k] = self._boost_from_average(k, False)
+                self._init_scores_ready = True
             init = jnp.asarray(np.asarray(self._init_scores, dtype=np.float32))
             scores = jnp.broadcast_to(init[:, None],
                                       (self.num_tree_per_iteration,
@@ -53,6 +73,17 @@ class RF(GBDT):
         # scores hold the average of trees so far: un-average, add, re-average
         it = self.iter_ + self.num_init_iteration
         grad, hess = self._get_gradients()
+        # RF gradients are constant across iterations: guard the pair ONCE
+        # when first computed (a per-iteration isfinite fetch would block
+        # the device queue 2x per iteration for an answer that cannot
+        # change) and cache the sanitized result + the skip verdict
+        if not self._rf_guarded:
+            grad, hess, self._rf_skip = self._guard_gradients(
+                grad, hess, force_check=True)
+            self._rf_grad = (grad, hess)
+            self._rf_guarded = True
+        if self._rf_skip:
+            return self._skip_iteration(self._init_scores)
         self._bagging(self.iter_)
 
         should_continue = False

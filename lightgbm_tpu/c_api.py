@@ -771,6 +771,22 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
                               num_iteration=None if ni < 0 else ni,
                               start_iteration=int(start_iteration))
 
+    @export("LGBM_BoosterSaveCheckpoint")
+    def _(handle, checkpoint_prefix):
+        # full train-state checkpoint (model + RNG streams + score caches),
+        # written atomically with CRC trailer — lightgbm_tpu/checkpoint.py
+        cb = _get(_opt_handle(handle))
+        cb.booster.save_checkpoint(_str(checkpoint_prefix))
+
+    @export("LGBM_BoosterResumeFromCheckpoint")
+    def _(handle, checkpoint_prefix, out_iteration):
+        # discovers the newest VALID checkpoint for the prefix (corrupt
+        # files fall back to older ones) and restores the full train state;
+        # out_iteration = restored iteration, 0 when none found
+        cb = _get(_opt_handle(handle))
+        out_iteration[0] = cb.booster.resume_from_checkpoint(
+            _str(checkpoint_prefix))
+
     def _model_to_buffer(text, buffer_len, out_len, out_str):
         data = text.encode("utf-8") + b"\0"
         out_len[0] = len(data)

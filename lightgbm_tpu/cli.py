@@ -104,7 +104,17 @@ class Application:
                  train_data.num_data, train_data.num_features)
         objective = create_objective(cfg.objective, cfg)
         booster = create_boosting(cfg.boosting, cfg, train_data, objective)
-        if cfg.input_model:
+        # preemption recovery: when snapshots are enabled and a previous run
+        # of this command left a checkpoint, resume it (newest VALID file —
+        # a corrupt/truncated latest falls back to the previous good one).
+        # Discovery happens up front so input_model loading is skipped, but
+        # the restore itself waits until the valid sets are attached (their
+        # score caches ride the checkpoint).
+        ckpt_state = None
+        if cfg.snapshot_freq > 0 and cfg.output_model:
+            from .checkpoint import load_latest_checkpoint
+            ckpt_state = load_latest_checkpoint(cfg.output_model)
+        if ckpt_state is None and cfg.input_model:
             with open(cfg.input_model) as fh:
                 booster.load_model_from_string(fh.read())
             booster.reset_training_data(train_data, objective)
@@ -117,8 +127,21 @@ class Application:
             valid = loader.load_from_file(valid_file, reference=train_data)
             booster.add_valid_data(valid, "valid_%d" % (i + 1),
                                    create_metrics(cfg.metric, cfg))
+        if ckpt_state is not None:
+            from .checkpoint import restore_state
+            restore_state(booster, ckpt_state)
         booster.train(snapshot_out=cfg.output_model)
-        booster.save_model(cfg.output_model)
+        from .parallel.learners import is_write_leader
+        if is_write_leader(getattr(booster, "mesh", None)):
+            # same leader-only write discipline as the in-loop snapshots:
+            # d hosts must not race the final rename or the cleanup unlinks
+            booster.save_model(cfg.output_model)
+            if cfg.snapshot_freq > 0 and cfg.output_model:
+                # the run COMPLETED: drop its checkpoints so a rerun of
+                # this command trains fresh instead of resuming a finished
+                # run
+                from .checkpoint import cleanup_checkpoints
+                cleanup_checkpoints(cfg.output_model)
         if cfg.verbosity > 0:
             global_timer.print()
 

@@ -253,6 +253,11 @@ class Config:
         elif self.boosting == "goss":
             if self.bagging_freq > 0 and self.bagging_fraction < 1.0:
                 Log.warning("Found bagging_fraction with goss; bagging is disabled in goss")
+        # TPU-runtime extension params (robustness subsystem)
+        self.nan_policy = str(self.nan_policy).lower()
+        if self.nan_policy not in ("raise", "skip_iter", "clip"):
+            Log.fatal("Unknown nan_policy %s (expected raise, skip_iter or "
+                      "clip)", self.nan_policy)
         # seed cascade (config.cpp:205-230): explicit `seed` derives the sub-seeds
         if "seed" in self.raw_params:
             base = int(self.seed)

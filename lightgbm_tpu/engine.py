@@ -35,8 +35,24 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
           early_stopping_rounds: Optional[int] = None,
           evals_result: Optional[Dict] = None, verbose_eval=True,
           learning_rates=None, keep_training_booster: bool = False,
-          callbacks=None) -> Booster:
-    """Train with given parameters; returns the trained Booster."""
+          callbacks=None, checkpoint_prefix: Optional[str] = None) -> Booster:
+    """Train with given parameters; returns the trained Booster.
+
+    ``checkpoint_prefix`` enables the fault-tolerant runtime: the full train
+    state (model + RNG streams + score caches + early-stopping bookkeeping,
+    lightgbm_tpu/checkpoint.py) is written atomically to
+    ``<prefix>.ckpt_iter_<n>`` every ``snapshot_freq`` iterations (param;
+    retention bounded by ``snapshot_keep``), and an interrupted run invoked
+    again with the same prefix resumes bit-exactly from the newest valid
+    checkpoint — corrupt/truncated files fall back to the previous good one.
+    A call that completes removes its checkpoints (resume covers interrupted
+    calls, not finished ones — continue a finished model via ``init_model``).
+    Known limit: the ``early_stopping_rounds`` CALLBACK keeps its
+    best-score/patience counters in a closure the checkpoint cannot reach,
+    so they restart on resume (the resumed run may stop later than the
+    uninterrupted one); the CLI / ``GBDT.train`` driver's internal
+    early-stopping state rides the checkpoint and resumes bit-exactly.
+    """
     params = copy.deepcopy(params) if params else {}
     for alias in _NUM_BOOST_ROUND_ALIASES:
         if alias in params:
@@ -103,6 +119,13 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         if idx and idx[0] < len(valid_names):
             train_data_name = valid_names[idx[0]]
 
+    resumed_iter = 0
+    if checkpoint_prefix is not None:
+        # restore AFTER the valid sets are attached: their score caches ride
+        # the checkpoint and are restored positionally
+        resumed_iter = booster._booster.resume_from_checkpoint(
+            checkpoint_prefix)
+
     callbacks = set() if callbacks is None else set(callbacks)
     if verbose_eval is True:
         callbacks.add(callback.print_evaluation())
@@ -125,7 +148,19 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     callbacks_after_iter = sorted(callbacks_after_iter,
                                   key=lambda cb: getattr(cb, "order", 0))
 
-    for i in range(init_iteration, init_iteration + num_boost_round):
+    ckpt_freq = int(getattr(booster.config, "snapshot_freq", -1))
+    if checkpoint_prefix is not None:
+        from .parallel.learners import is_write_leader
+        write_ckpt = is_write_leader(booster._booster.mesh)
+        if ckpt_freq <= 0:
+            Log.warning(
+                "checkpoint_prefix is set but snapshot_freq is not (<= 0): "
+                "no checkpoints will be written — pass snapshot_freq in "
+                "params to choose the cadence")
+    else:
+        write_ckpt = False
+    for i in range(init_iteration + resumed_iter,
+                   init_iteration + num_boost_round):
         for cb in callbacks_before_iter:
             cb(callback.CallbackEnv(model=booster, params=params, iteration=i,
                                     begin_iteration=init_iteration,
@@ -150,8 +185,22 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             booster.best_iteration = earlyStopException.best_iteration + 1
             evaluation_result_list = earlyStopException.best_score
             break
+        if (write_ckpt and ckpt_freq > 0
+                and booster._booster.iter_ % ckpt_freq == 0):
+            booster._booster.save_checkpoint(checkpoint_prefix)
         if finished:
             break
+    # the trailing < _poll_freq iterations' isfinite reductions
+    # (nan_policy=raise) are only fetched by _poll_stop; drain them here so
+    # a bad batch near the end still raises instead of returning NaN trees
+    booster._booster._drain_nonfinite_checks()
+    if write_ckpt:
+        # this call COMPLETED (ran its rounds or stopped early): drop its
+        # checkpoints so a rerun with the same prefix trains instead of
+        # silently returning the finished run's model.  An interrupted call
+        # never reaches this line — its checkpoints survive for the resume.
+        from .checkpoint import cleanup_checkpoints
+        cleanup_checkpoints(checkpoint_prefix)
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for data_name, eval_name, e_val, _ in (evaluation_result_list or []):
         booster.best_score[data_name][eval_name] = e_val

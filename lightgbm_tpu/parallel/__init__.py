@@ -8,11 +8,12 @@ from .learners import (DataParallelTreeLearner,
                        FeatureParallelTreeLearner,
                        PartitionedDataParallelTreeLearner,
                        VotingParallelTreeLearner, create_tree_learner,
-                       default_mesh, sharded_predict, sharded_predict_fn)
+                       default_mesh, is_write_leader, sharded_predict,
+                       sharded_predict_fn)
 
 __all__ = [
     "DataParallelTreeLearner",
     "FeatureParallelTreeLearner", "PartitionedDataParallelTreeLearner",
     "VotingParallelTreeLearner", "create_tree_learner", "default_mesh",
-    "sharded_predict", "sharded_predict_fn",
+    "is_write_leader", "sharded_predict", "sharded_predict_fn",
 ]

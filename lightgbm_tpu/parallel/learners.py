@@ -55,6 +55,19 @@ def default_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def is_write_leader(mesh: Optional[Mesh] = None) -> bool:
+    """True when this host should perform model/checkpoint file writes.
+
+    An in-process mesh (8 local devices) has a single controller — always
+    the leader.  On a multi-process pod every process runs the same
+    training loop over a shared filesystem, so only process 0 writes:
+    d racing writers would interleave tmp-file renames and retention
+    deletes on the SAME paths (checkpoint.py prune).  ``mesh`` is accepted
+    for future per-mesh leadership; today leadership is process-global."""
+    del mesh  # single-controller meshes: leadership is process-global
+    return jax.process_index() == 0
+
+
 # ---- sharded batch prediction (core/predict_fused.py over the mesh) ----
 
 _SHARDED_PREDICT_FNS: dict = {}

@@ -1,16 +1,116 @@
-"""Virtual file IO — scheme-dispatched readers/writers.
+"""Virtual file IO — scheme-dispatched readers/writers + atomic writes.
 
 Counterpart of the reference's ``VirtualFileReader``/``VirtualFileWriter``
 (src/io/file_io.cpp:62-134, utils/file_io.h): local files by default, with a
 registry for remote schemes.  ``hdfs://`` routes through ``pyarrow.fs`` when
 available (the reference links libhdfs under USE_HDFS); other schemes can be
 registered by embedding hosts.
+
+``atomic_write`` is the durability primitive every model/snapshot/checkpoint
+write goes through: the bytes land in a same-directory temp file, are fsynced,
+and are renamed over the destination, so a kill at ANY point leaves either the
+old complete file or the new complete file — never a truncated mix.  A
+process-global fault hook (``set_fault_hook``) lets tests and
+tools/fault_injection.py kill the writer between the temp write and the
+rename, proving that property.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict
+import os
+import zlib
+from typing import Callable, Dict, Optional
 
 _SCHEMES: Dict[str, Callable] = {}
+
+# test/tool hook: called with the stage name ("written", "synced") while the
+# temp file exists but the rename has not happened; raising (or killing the
+# process) from it simulates a crash mid-write
+_FAULT_HOOK: Optional[Callable[[str, str], None]] = None
+
+
+def set_fault_hook(hook: Optional[Callable[[str, str], None]]) -> None:
+    """Install ``hook(stage, path)`` fired inside :func:`atomic_write` before
+    the rename (stages: "written" after the temp write, "synced" after fsync).
+    Pass ``None`` to clear.  Used by the fault-injection harness to prove a
+    mid-write kill never corrupts the destination file."""
+    global _FAULT_HOOK
+    _FAULT_HOOK = hook
+
+
+def atomic_write(path: str, data, fsync: bool = True) -> None:
+    """Write ``data`` (str or bytes) to ``path`` atomically.
+
+    tmp file in the same directory -> write -> fsync -> rename(tmp, path).
+    ``os.replace`` is atomic on POSIX (and on Windows for same-volume paths),
+    so readers never observe a partial file and a crash leaves the previous
+    version intact.  Remote ``scheme://`` paths fall back to a plain
+    streamed write (their stores provide their own atomicity, if any).
+    """
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    if "://" in path:
+        with open_file(path, "wb") as fh:
+            fh.write(data)
+        return
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, ".%s.tmp.%d" % (os.path.basename(path), os.getpid()))
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            if _FAULT_HOOK is not None:
+                _FAULT_HOOK("written", path)
+            if fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        if _FAULT_HOOK is not None:
+            _FAULT_HOOK("synced", path)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+_CRC_TRAILER = b"\nCRC32 "
+
+
+def append_crc_trailer(data: bytes) -> bytes:
+    """Append a ``\\nCRC32 xxxxxxxx nnnnnnnnnnnn\\n`` trailer: checksum and
+    byte length of everything before the trailer, so truncation AND bit-flips
+    are both detectable."""
+    return data + _CRC_TRAILER + (
+        "%08x %012d\n" % (zlib.crc32(data) & 0xFFFFFFFF, len(data))
+    ).encode("ascii")
+
+
+def check_crc_trailer(blob: bytes) -> bytes:
+    """Validate and strip the trailer written by :func:`append_crc_trailer`.
+
+    Returns the payload bytes; raises ``ValueError`` naming what failed
+    (missing trailer / length mismatch i.e. truncation / checksum mismatch)."""
+    tail_len = len(_CRC_TRAILER) + 8 + 1 + 12 + 1
+    if len(blob) < tail_len or not blob.endswith(b"\n"):
+        raise ValueError("checkpoint trailer missing (file truncated?)")
+    payload, trailer = blob[:-tail_len], blob[-tail_len:]
+    if not trailer.startswith(_CRC_TRAILER):
+        raise ValueError("checkpoint trailer missing (file truncated?)")
+    try:
+        crc_hex, length = trailer[len(_CRC_TRAILER):].split()
+        want_crc = int(crc_hex, 16)
+        want_len = int(length)
+    except ValueError:
+        raise ValueError("checkpoint trailer malformed")
+    if want_len != len(payload):
+        raise ValueError("checkpoint length mismatch: trailer says %d bytes, "
+                         "file has %d (truncated or concatenated)"
+                         % (want_len, len(payload)))
+    got = zlib.crc32(payload) & 0xFFFFFFFF
+    if got != want_crc:
+        raise ValueError("checkpoint CRC32 mismatch: %08x != %08x (corrupt)"
+                         % (got, want_crc))
+    return payload
 
 
 def register_scheme(prefix: str, opener: Callable) -> None:

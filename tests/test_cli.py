@@ -101,6 +101,63 @@ def test_cli_train_predict_matches_python(data_files):
     assert acc > 0.8
 
 
+def test_cli_train_auto_resume(data_files, tmp_path, monkeypatch):
+    """task=train with snapshot_freq resumes a preempted run: rerunning the
+    SAME command discovers the newest valid checkpoint, restores the full
+    train state, and finishes with the identical model file.  A COMPLETED
+    run cleans its checkpoints up, so a further rerun trains fresh."""
+    from lightgbm_tpu import checkpoint as ckpt_mod
+    from lightgbm_tpu.utils import file_io
+    tmp, train, test, X, y = data_files
+    model = str(tmp_path / "model_resume.txt")
+    args = ["task=train", "data=%s" % train, "objective=binary",
+            "num_trees=12", "num_leaves=15", "output_model=%s" % model,
+            "verbosity=-1", "metric=binary_logloss", "snapshot_freq=5"]
+
+    # run 1, "preempted": die inside the FINAL model write — snapshots and
+    # checkpoints for iterations 5/10 are already on disk, the model is not
+    class Preempted(RuntimeError):
+        pass
+
+    def die_on_final_write(stage, path):
+        if path == model:
+            raise Preempted(path)
+
+    file_io.set_fault_hook(die_on_final_write)
+    try:
+        with pytest.raises(Preempted):
+            Application(args).run()
+    finally:
+        file_io.set_fault_hook(None)
+    assert not os.path.exists(model)
+    assert [it for it, _ in ckpt_mod.list_checkpoints(model)] == [10, 5]
+
+    # run 2, same command: must RESUME from iteration 10 (spy on discovery),
+    # finish, and clean its checkpoints up
+    seen = {}
+    orig = ckpt_mod.load_latest_checkpoint
+
+    def spy(prefix):
+        res = orig(prefix)
+        seen["iteration"] = None if res is None else res[0]["iteration"]
+        return res
+
+    monkeypatch.setattr(ckpt_mod, "load_latest_checkpoint", spy)
+    Application(args).run()
+    assert seen["iteration"] == 10
+    assert ckpt_mod.list_checkpoints(model) == []
+    with open(model) as fh:
+        resumed = fh.read()
+
+    # run 3, same command again: no checkpoints left -> trains FRESH from 0
+    # and must reproduce the killed+resumed model bit-for-bit
+    seen.clear()
+    Application(args).run()
+    assert seen["iteration"] is None
+    with open(model) as fh:
+        assert fh.read() == resumed
+
+
 def test_cli_with_config_file(data_files):
     tmp, train, test, X, y = data_files
     model = str(tmp / "model2.txt")

@@ -1,5 +1,6 @@
-"""Build ``lib_lightgbm_tpu.so`` — a real C shared library exporting the 64
-``LGBM_*`` symbols (ABI of the reference's ``lib_lightgbm.so``,
+"""Build ``lib_lightgbm_tpu.so`` — a real C shared library exporting the 66
+``LGBM_*`` symbols (ABI of the reference's ``lib_lightgbm.so`` plus the
+checkpoint/resume pair,
 include/LightGBM/c_api.h) via cffi embedding: the C entry points run the
 Python engine in an embedded interpreter, so external ctypes / JNI / R
 callers need no Python of their own on the call site.
@@ -137,6 +138,10 @@ int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
     double* out_result);
 int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
     int num_iteration, const char* filename);
+int LGBM_BoosterSaveCheckpoint(BoosterHandle handle,
+    const char* checkpoint_prefix);
+int LGBM_BoosterResumeFromCheckpoint(BoosterHandle handle,
+    const char* checkpoint_prefix, int* out_iteration);
 int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
     int num_iteration, int64_t buffer_len, int64_t* out_len, char* out_str);
 int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
