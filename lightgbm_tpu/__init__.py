@@ -5,6 +5,7 @@ Same public surface as the reference's python-package
 wrappers, callbacks, plotting — backed by JAX/XLA/Pallas device compute
 instead of the C++ core.
 """
+from . import obs
 from .basic import Booster, Dataset
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)
@@ -25,7 +26,7 @@ except ImportError:  # pragma: no cover
 __version__ = "2.3.2"
 
 __all__ = ["Dataset", "Booster", "CVBooster", "LightGBMError",
-           "train", "cv",
+           "train", "cv", "obs",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "early_stopping", "print_evaluation", "record_evaluation",
            "reset_parameter", "EarlyStopException",

@@ -549,6 +549,23 @@ class Booster:
         restored iteration, 0 when no usable checkpoint exists."""
         return self._booster.resume_from_checkpoint(checkpoint_prefix)
 
+    # ---- telemetry (lightgbm_tpu/obs) ----
+
+    def telemetry_summary(self) -> Optional[Dict]:
+        """Summary dict of the process-active telemetry run (counters,
+        gauges, histograms with p50/p99, recompile counts per shape bucket,
+        host-phase timings, MFU gauges when recorded) — None when telemetry
+        is off.  Runs the engine/CLI own (``telemetry_out`` param) are
+        finalized to ``<out>.summary.json`` and CLOSED when training ends;
+        use ``lightgbm_tpu.obs.configure`` for a run this method can read
+        mid-flight."""
+        from . import obs
+        tele = obs.active()
+        if tele is None:
+            return None
+        from .obs.report import summarize
+        return summarize(tele)
+
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> Dict:
         b = self._booster

@@ -5,6 +5,7 @@ from __future__ import annotations
 import numpy as np
 
 from .gbdt import GBDT
+from ..obs import active as _telemetry_active
 from ..utils.log import Log
 
 
@@ -96,6 +97,14 @@ class DART(GBDT):
                 tree.shrink(-1.0)
                 self._add_tree_score_train(tree, c)
         kdrop = len(self.drop_index)
+        tele = _telemetry_active()
+        if tele is not None:
+            tele.histogram("dart_dropped_trees").observe(kdrop)
+            # JSONL growth bounded by the telemetry_freq cadence like
+            # engine.train's iteration events; the histogram sees every drop
+            if self.iter_ % tele.freq == 0:
+                tele.event("dart_drop", iteration=int(self.iter_),
+                           dropped=int(kdrop))
         if not self.config.xgboost_dart_mode:
             self.shrinkage_rate = self.config.learning_rate / (1.0 + kdrop)
         else:

@@ -35,6 +35,9 @@ from ..parallel import create_tree_learner
 from ..io.dataset import BinnedDataset
 from ..metric.metric import Metric, create_metrics
 from ..objective import ObjectiveFunction, create_objective
+from ..obs import active as _telemetry_active
+from ..obs import annotate as _annotate
+from ..obs import recompile as _recompile
 from ..utils.file_io import atomic_write
 from ..utils.log import LightGBMError, Log
 from ..utils.timer import FunctionTimer
@@ -943,10 +946,19 @@ class GBDT:
                           len(self._models), self.iter_,
                           self.bag_mask, self.bag_data_cnt)
         if not self._can_fuse_iters():
+            tele = _telemetry_active()
+            t0 = time.perf_counter()
+            it0 = self.iter_
+            stopped = False
             for _ in range(num_iters):
                 if self.train_one_iter():
-                    return True
-            return False
+                    stopped = True
+                    break
+            if tele is not None:
+                self._record_chunk_telemetry(tele, it0,
+                                             time.perf_counter() - t0,
+                                             fused=False)
+            return stopped
         # probe traceability BEFORE any state mutation so the fallback path
         # does not re-apply boost_from_average
         key = (num_iters, self.shrinkage_rate, self.num_tree_per_iteration,
@@ -963,10 +975,15 @@ class GBDT:
                 self._fuse_failed = True
                 return self.train_chunk(num_iters)
             self._fused_cache[key] = fn
+            # the fused k-iteration scan compiled a fresh XLA program; a
+            # steady-state run reuses config-keyed chunk lengths, so this
+            # counter going flat after warmup IS the no-recompile invariant
+            _recompile.record("fused_train", "k=%d" % num_iters)
         init_scores = [self._boost_from_average(kk, True)
                        for kk in range(self.num_tree_per_iteration)]
         t0 = time.perf_counter()
-        with FunctionTimer("GBDT::TrainChunk(dispatch)"):
+        with FunctionTimer("GBDT::TrainChunk(dispatch)"), \
+                _annotate("fused_train_chunk"):
             new_score, new_vscores, stacked = fn(
                 self.train_score,
                 tuple(vs["score"] for vs in self.valid_sets),
@@ -992,9 +1009,34 @@ class GBDT:
         self.iter_ += num_iters
         Log.debug("%f seconds elapsed, dispatched iterations %d-%d",
                   time.perf_counter() - t0, first_iter + 1, self.iter_)
+        tele = _telemetry_active()
+        if tele is not None:
+            self._record_chunk_telemetry(tele, first_iter,
+                                         time.perf_counter() - t0,
+                                         fused=True)
         if self.iter_ - self._last_poll >= self._poll_freq:
             return self._poll_stop()
         return False
+
+    def _record_chunk_telemetry(self, tele, first_iter: int, dt: float,
+                                fused: bool) -> None:
+        """Per-chunk metrics/events; the chunk is the host-work granularity
+        of the async pipeline, so telemetry-off runs are untouched per
+        iteration.  ``dt`` is the host DISPATCH wall (device completion is
+        async); end-to-end run walls come from the run driver's gauges."""
+        iters = self.iter_ - first_iter
+        if iters <= 0:
+            return
+        rows = float(self.num_data) * iters
+        tele.histogram("chunk_dispatch_s").observe(dt)
+        rate = rows / dt if dt > 0 else 0.0
+        tele.histogram("chunk_rows_per_s").observe(rate)
+        tele.histogram("chunk_ns_per_row").observe(
+            dt / rows * 1e9 if rows else 0.0)
+        tele.gauge("bag_data_cnt").set(self.bag_data_cnt)
+        tele.event("train_chunk", first_iter=int(first_iter),
+                   iters=int(iters), dt_s=dt, rows_per_s=rate,
+                   fused=bool(fused), bag_data_cnt=int(self.bag_data_cnt))
 
     def _train_one_iter_sync(self, gradients: Optional[np.ndarray] = None,
                              hessians: Optional[np.ndarray] = None) -> bool:
@@ -1104,7 +1146,19 @@ class GBDT:
         return str(getattr(self.config, "nan_policy", "raise"))
 
     @staticmethod
+    def _nan_trip_telemetry(iteration: int, policy: str, action: str) -> None:
+        """Cold-path accounting for non-finite guard trips."""
+        tele = _telemetry_active()
+        if tele is not None:
+            tele.counter("nan_policy_trips").inc()
+            if action == "rollback_retry":
+                tele.counter("nan_rollback_retries").inc()
+            tele.event("nan_trip", iteration=int(iteration), policy=policy,
+                       action=action)
+
+    @staticmethod
     def _raise_nonfinite(iteration: int) -> None:
+        GBDT._nan_trip_telemetry(iteration, "raise", "raise")
         raise LightGBMError(
             "non-finite gradients/hessians/scores at iteration %d "
             "(nan_policy=raise); set nan_policy=skip_iter or clip to "
@@ -1146,9 +1200,11 @@ class GBDT:
             Log.warning("non-finite gradients/hessians at iteration %d; "
                         "skipping the iteration (nan_policy=skip_iter)",
                         self.iter_)
+            self._nan_trip_telemetry(self.iter_, policy, "skip_iter")
             return grad, hess, True
         Log.warning("non-finite gradients/hessians at iteration %d; "
                     "clipping (nan_policy=clip)", self.iter_)
+        self._nan_trip_telemetry(self.iter_, policy, "clip")
         grad = xp.nan_to_num(grad, nan=0.0, posinf=self._NAN_CLIP,
                              neginf=-self._NAN_CLIP)
         # hessians are curvature weights: non-negative by contract
@@ -1222,6 +1278,8 @@ class GBDT:
         Log.warning("non-finite training scores detected; rolled back to "
                     "iteration %d and retrying per-iteration "
                     "(nan_policy=%s)", self.iter_, self._nan_policy)
+        self._nan_trip_telemetry(self.iter_, self._nan_policy,
+                                 "rollback_retry")
         self._nan_rolled_back_at = self.iter_
         # re-run the window with per-iteration guards; re-armed once a
         # retried window completes clean (see above)
@@ -1553,6 +1611,7 @@ class GBDT:
 
     def train(self, snapshot_out: Optional[str] = None) -> None:
         t_start = time.perf_counter()
+        it_start = self.iter_  # nonzero on a checkpoint resume
         total = int(self.config.num_iterations)
         has_eval = bool(self.train_metrics) or bool(self.valid_sets)
         mf = int(self.config.metric_freq)
@@ -1599,6 +1658,16 @@ class GBDT:
             self._poll_stop()  # trim any trailing stalled iterations
         elif self._fin_handles:
             self._drain_nonfinite_checks()
+        tele = _telemetry_active()
+        if tele is not None:
+            # headline gauges report.summarize folds into row-trees/s; the
+            # run owner (cli/engine/bench) calls report.finalize_run.
+            # Iterations are the ones trained THIS call — a resumed run's
+            # wall covers only this process, so counting the restored
+            # iterations would inflate the throughput headline
+            tele.gauge("train_rows").set(int(self.num_data))
+            tele.gauge("train_iterations").set(int(self.iter_ - it_start))
+            tele.gauge("train_wall_s").set(time.perf_counter() - t_start)
 
     def _write_snapshot(self, snapshot_out: str) -> None:
         """Periodic durability point: the reference-compatible model snapshot
@@ -1632,12 +1701,19 @@ class GBDT:
         return out
 
     def eval_and_check_early_stopping(self) -> bool:
+        tele = _telemetry_active()
         for ds, name, val, _ in self.eval_train():
             Log.info("Iteration:%d, %s %s : %g", self.iter_, ds, name, val)
+            if tele is not None:
+                tele.event("eval", iteration=int(self.iter_), dataset=ds,
+                           metric=name, value=float(val))
         stop = False
         rounds = int(self.config.early_stopping_round)
         for ds, name, val, bigger_better in self.eval_valid():
             Log.info("Iteration:%d, valid_1 %s : %g", self.iter_, name, val)
+            if tele is not None:
+                tele.event("eval", iteration=int(self.iter_), dataset=ds,
+                           metric=name, value=float(val))
             if rounds > 0:
                 key = (ds, name)
                 cur = val if bigger_better else -val

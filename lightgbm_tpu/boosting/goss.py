@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .gbdt import GBDT
+from ..obs import active as _telemetry_active
 from ..utils.log import Log
 
 
@@ -56,6 +57,17 @@ class GOSS(GBDT):
             w[other_idx] = multiply
             self.bag_data_cnt = top_k + len(other_idx)
             self.bag_mask = None  # weights are folded into grad/hess below
+            tele = _telemetry_active()
+            if tele is not None:
+                tele.gauge("goss_top_k").set(top_k)
+                tele.gauge("goss_other_k").set(len(other_idx))
+                # JSONL growth bounded by the telemetry_freq cadence like
+                # engine.train's iteration events; gauges always current
+                if self.iter_ % tele.freq == 0:
+                    tele.event("goss_select", iteration=int(self.iter_),
+                               top_k=int(top_k),
+                               other_k=int(len(other_idx)),
+                               multiplier=float(multiply))
             wj = jnp.asarray(w)[None, :]
             return grad * wj, hess * wj
         return grad, hess

@@ -280,6 +280,36 @@ def _num_predict_per_row(cb: _CBooster, predict_type: int,
     return nc if nc > 1 else 1
 
 
+def _impl_telemetry_configure(out_path: str, freq: int) -> None:
+    """Start (or reconfigure) the process-active telemetry run; an empty
+    ``out_path`` keeps events in memory only."""
+    from . import obs
+    obs.configure(out=out_path or None, freq=int(freq) or 1, entry="c_api")
+
+
+def _impl_telemetry_disable() -> None:
+    from . import obs
+    obs.disable()
+
+
+def _impl_telemetry_summary() -> str:
+    """Summary JSON of the active telemetry run ("" when telemetry is off)."""
+    from . import obs
+    tele = obs.active()
+    if tele is None:
+        return ""
+    from .obs.report import summarize
+    return json.dumps(summarize(tele), default=str)
+
+
+def _impl_telemetry_recompile_count() -> int:
+    """Total jit-cache misses recorded by the always-on recompile gauge
+    (obs.recompile) — the live "steady-state serving never recompiles"
+    invariant, readable without configuring a telemetry run."""
+    from .obs import recompile
+    return int(recompile.total())
+
+
 def _impl_predict_for_file(cb: _CBooster, data_filename: str,
                            data_has_header: int, predict_type: int,
                            num_iteration: int, parameter: str,
@@ -833,6 +863,25 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
             importance_type=itype,
             iteration=None if int(num_iteration) <= 0 else int(num_iteration))
         _write_out(out_results, np.asarray(imp, dtype=np.float64))
+
+    # ---- telemetry (lightgbm_tpu/obs) ----
+
+    @export("LGBM_TelemetryConfigure")
+    def _(out_path, freq):
+        _impl_telemetry_configure(_str(out_path), int(freq))
+
+    @export("LGBM_TelemetryDisable")
+    def _():
+        _impl_telemetry_disable()
+
+    @export("LGBM_TelemetrySummary")
+    def _(buffer_len, out_len, out_str):
+        _model_to_buffer(_impl_telemetry_summary(), buffer_len, out_len,
+                         out_str)
+
+    @export("LGBM_TelemetryRecompileCount")
+    def _(out_count):
+        out_count[0] = _impl_telemetry_recompile_count()
 
     # ---- network shims (network.cpp -> XLA collectives; see SURVEY §2.3) ----
 

@@ -152,10 +152,23 @@ def save_checkpoint(booster, prefix: str, keep: Optional[int] = None) -> str:
     """Capture the booster's full train state and write it atomically to
     ``<prefix>.ckpt_iter_<iteration>``; prune to the newest ``keep`` files
     (``snapshot_keep`` param when None; <= 0 keeps everything)."""
-    meta, arrays, model_str = booster.capture_train_state()
-    path = checkpoint_path(prefix, int(meta["iteration"]))
-    atomic_write(path, serialize_state(meta, arrays, model_str))
+    import time
+
+    from .utils.timer import FunctionTimer
+    t0 = time.perf_counter()
+    with FunctionTimer("Checkpoint::Write"):
+        meta, arrays, model_str = booster.capture_train_state()
+        path = checkpoint_path(prefix, int(meta["iteration"]))
+        blob = serialize_state(meta, arrays, model_str)
+        atomic_write(path, blob)
     Log.info("Wrote checkpoint %s", path)
+    from .obs import active as _telemetry_active
+    tele = _telemetry_active()
+    if tele is not None:
+        dt = time.perf_counter() - t0
+        tele.histogram("checkpoint_write_s").observe(dt)
+        tele.event("checkpoint_write", iteration=int(meta["iteration"]),
+                   dt_s=dt, bytes=len(blob))
     if keep is None:
         keep = int(getattr(booster.config, "snapshot_keep", 0))
     prune_checkpoints(prefix, keep)
@@ -226,10 +239,22 @@ def restore_state(booster, state) -> int:
     (from :func:`load_latest_checkpoint`) into ``booster`` and log it.
     Split from :func:`restore_checkpoint` for callers that must discover
     the checkpoint BEFORE attaching valid sets (cli.py task=train)."""
+    import time
+
+    from .utils.timer import FunctionTimer
     meta, arrays, model_str, path = state
-    booster.restore_train_state(meta, arrays, model_str)
+    t0 = time.perf_counter()
+    with FunctionTimer("Checkpoint::Restore"):
+        booster.restore_train_state(meta, arrays, model_str)
     Log.info("Resumed training from checkpoint %s (iteration %d)",
              path, booster.iter_)
+    from .obs import active as _telemetry_active
+    tele = _telemetry_active()
+    if tele is not None:
+        dt = time.perf_counter() - t0
+        tele.histogram("checkpoint_restore_s").observe(dt)
+        tele.event("checkpoint_restore", iteration=int(meta["iteration"]),
+                   dt_s=dt, path=path)
     return int(meta["iteration"])
 
 

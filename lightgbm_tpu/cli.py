@@ -94,81 +94,141 @@ class Application:
 
     # ---- task=train (application.cpp:84-213) ----
 
-    def train(self) -> None:
+    def _configure_telemetry(self):
+        """Start a telemetry run when the config asks for one
+        (telemetry_out=...); returns the Telemetry or None."""
         cfg = self.config
-        loader = DatasetLoader(cfg)
-        num_machines = max(int(cfg.num_machines), 1)
-        rank = 0  # single-host CLI; multi-chip parallelism is in-process
-        train_data = loader.load_from_file(cfg.data, rank, num_machines)
-        Log.info("Finished loading data: %d rows, %d features",
-                 train_data.num_data, train_data.num_features)
-        objective = create_objective(cfg.objective, cfg)
-        booster = create_boosting(cfg.boosting, cfg, train_data, objective)
-        # preemption recovery: when snapshots are enabled and a previous run
-        # of this command left a checkpoint, resume it (newest VALID file —
-        # a corrupt/truncated latest falls back to the previous good one).
-        # Discovery happens up front so input_model loading is skipped, but
-        # the restore itself waits until the valid sets are attached (their
-        # score caches ride the checkpoint).
-        ckpt_state = None
-        if cfg.snapshot_freq > 0 and cfg.output_model:
-            from .checkpoint import load_latest_checkpoint
-            ckpt_state = load_latest_checkpoint(cfg.output_model)
-        if ckpt_state is None and cfg.input_model:
-            with open(cfg.input_model) as fh:
-                booster.load_model_from_string(fh.read())
-            booster.reset_training_data(train_data, objective)
-            # one blocked binned pass over the whole loaded model instead
-            # of a per-tree device dispatch (core/predict_fused.py)
-            booster.replay_train_score()
-        if cfg.is_provide_training_metric:
-            booster.add_train_metrics(create_metrics(cfg.metric, cfg))
-        for i, valid_file in enumerate(cfg.valid or []):
-            valid = loader.load_from_file(valid_file, reference=train_data)
-            booster.add_valid_data(valid, "valid_%d" % (i + 1),
-                                   create_metrics(cfg.metric, cfg))
-        if ckpt_state is not None:
-            from .checkpoint import restore_state
-            restore_state(booster, ckpt_state)
-        booster.train(snapshot_out=cfg.output_model)
+        t_out = str(getattr(cfg, "telemetry_out", "") or "")
+        if not t_out:
+            return None
         from .parallel.learners import is_write_leader
-        if is_write_leader(getattr(booster, "mesh", None)):
-            # same leader-only write discipline as the in-loop snapshots:
-            # d hosts must not race the final rename or the cleanup unlinks
-            booster.save_model(cfg.output_model)
+        if not is_write_leader(None):
+            # same leader-only file discipline as model/checkpoint writes:
+            # d pod processes must not truncate/interleave one JSONL path
+            Log.debug("telemetry_out ignored on non-leader process")
+            return None
+        from . import obs
+        return obs.configure(out=t_out,
+                             freq=int(getattr(cfg, "telemetry_freq", 1)),
+                             entry="cli", task=str(cfg.task))
+
+    @staticmethod
+    def _close_telemetry(tele):
+        """Ownership backstop: close the CLI-owned run if it is still the
+        process-active one (the success paths finalize + disable first; an
+        exception mid-task must not leak the run into a later command)."""
+        if tele is None:
+            return
+        from . import obs
+        if obs.active() is tele:
+            obs.disable()
+
+    def train(self) -> None:
+        import time
+        cfg = self.config
+        tele = self._configure_telemetry()
+        t_start = time.perf_counter()
+        try:
+            loader = DatasetLoader(cfg)
+            num_machines = max(int(cfg.num_machines), 1)
+            rank = 0  # single-host CLI; multi-chip parallelism is in-process
+            train_data = loader.load_from_file(cfg.data, rank, num_machines)
+            Log.info("Finished loading data: %d rows, %d features",
+                     train_data.num_data, train_data.num_features)
+            objective = create_objective(cfg.objective, cfg)
+            booster = create_boosting(cfg.boosting, cfg, train_data, objective)
+            # preemption recovery: when snapshots are enabled and a previous run
+            # of this command left a checkpoint, resume it (newest VALID file —
+            # a corrupt/truncated latest falls back to the previous good one).
+            # Discovery happens up front so input_model loading is skipped, but
+            # the restore itself waits until the valid sets are attached (their
+            # score caches ride the checkpoint).
+            ckpt_state = None
             if cfg.snapshot_freq > 0 and cfg.output_model:
-                # the run COMPLETED: drop its checkpoints so a rerun of
-                # this command trains fresh instead of resuming a finished
-                # run
-                from .checkpoint import cleanup_checkpoints
-                cleanup_checkpoints(cfg.output_model)
-        if cfg.verbosity > 0:
-            global_timer.print()
+                from .checkpoint import load_latest_checkpoint
+                ckpt_state = load_latest_checkpoint(cfg.output_model)
+            if ckpt_state is None and cfg.input_model:
+                with open(cfg.input_model) as fh:
+                    booster.load_model_from_string(fh.read())
+                booster.reset_training_data(train_data, objective)
+                # one blocked binned pass over the whole loaded model instead
+                # of a per-tree device dispatch (core/predict_fused.py)
+                booster.replay_train_score()
+            if cfg.is_provide_training_metric:
+                booster.add_train_metrics(create_metrics(cfg.metric, cfg))
+            for i, valid_file in enumerate(cfg.valid or []):
+                valid = loader.load_from_file(valid_file, reference=train_data)
+                booster.add_valid_data(valid, "valid_%d" % (i + 1),
+                                       create_metrics(cfg.metric, cfg))
+            if ckpt_state is not None:
+                from .checkpoint import restore_state
+                restore_state(booster, ckpt_state)
+            it_start = int(booster.iter_)  # nonzero on a checkpoint resume
+            booster.train(snapshot_out=cfg.output_model)
+            from .parallel.learners import is_write_leader
+            if is_write_leader(getattr(booster, "mesh", None)):
+                # same leader-only write discipline as the in-loop snapshots:
+                # d hosts must not race the final rename or the cleanup unlinks
+                booster.save_model(cfg.output_model)
+                if cfg.snapshot_freq > 0 and cfg.output_model:
+                    # the run COMPLETED: drop its checkpoints so a rerun of
+                    # this command trains fresh instead of resuming a finished
+                    # run
+                    from .checkpoint import cleanup_checkpoints
+                    cleanup_checkpoints(cfg.output_model)
+            if tele is not None:
+                # GBDT.train recorded the run gauges; fold in the MFU estimate
+                # and write <telemetry_out>.summary.json — one flag turned this
+                # run into a BENCH artifact.  The CLI owns the run: close it.
+                from . import obs
+                from .obs.report import finalize_run
+                # iterations trained THIS process only: a resumed run's wall
+                # excludes the pre-preemption work, so must its iter count
+                finalize_run(tele, gbdt=booster,
+                             wall_s=time.perf_counter() - t_start,
+                             iters=int(booster.iter_) - it_start)
+                obs.disable()
+            if cfg.verbosity > 0:
+                global_timer.print()
+        finally:
+            self._close_telemetry(tele)
 
     # ---- task=predict (application.cpp:215-252, predictor.hpp) ----
 
     def predict(self) -> None:
         cfg = self.config
         if not cfg.input_model:
+            # validate BEFORE starting a telemetry run: Log.fatal raises,
+            # and a run opened here would leak past the try/finally below
             Log.fatal("Need input_model for prediction task")
-        booster = GBDT.load_model(cfg.input_model, cfg)
-        loader = DatasetLoader(cfg)
-        X = loader.load_prediction_data(cfg.data)
-        num_iter = int(cfg.num_iteration_predict)
-        if cfg.predict_leaf_index:
-            out = booster.predict_leaf_index(X, num_iter)
-        elif cfg.predict_contrib:
-            out = booster.predict_contrib(X, num_iter)
-        else:
-            out = booster.predict(X, raw_score=bool(cfg.predict_raw_score),
-                                  num_iteration=num_iter)
-        with open(cfg.output_result, "w") as fh:
-            for row in np.atleast_1d(out):
-                if np.ndim(row) == 0:
-                    fh.write("%g\n" % row)
-                else:
-                    fh.write("\t".join("%g" % v for v in row) + "\n")
-        Log.info("Finished prediction, wrote results to %s", cfg.output_result)
+        tele = self._configure_telemetry()
+        try:
+            booster = GBDT.load_model(cfg.input_model, cfg)
+            loader = DatasetLoader(cfg)
+            X = loader.load_prediction_data(cfg.data)
+            num_iter = int(cfg.num_iteration_predict)
+            if cfg.predict_leaf_index:
+                out = booster.predict_leaf_index(X, num_iter)
+            elif cfg.predict_contrib:
+                out = booster.predict_contrib(X, num_iter)
+            else:
+                out = booster.predict(X, raw_score=bool(cfg.predict_raw_score),
+                                      num_iteration=num_iter)
+            with open(cfg.output_result, "w") as fh:
+                for row in np.atleast_1d(out):
+                    if np.ndim(row) == 0:
+                        fh.write("%g\n" % row)
+                    else:
+                        fh.write("\t".join("%g" % v for v in row) + "\n")
+            Log.info("Finished prediction, wrote results to %s", cfg.output_result)
+            if tele is not None:
+                # per-bucket predict latencies + recompile counts ride the run
+                from . import obs
+                from .obs.report import finalize_run
+                finalize_run(tele, extra={"rows_predicted": int(len(X))})
+                obs.disable()
+        finally:
+            self._close_telemetry(tele)
 
     # ---- task=convert_model (gbdt_model_text.cpp:87 ModelToIfElse) ----
 

@@ -37,6 +37,7 @@ tests/test_partition_buckets.py pins the split-kernel variants.
 from __future__ import annotations
 
 import functools
+import time
 from typing import List, NamedTuple, Optional
 
 import jax
@@ -44,6 +45,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.binning import BinType, MissingType
+from ..obs import active as _telemetry_active
+from ..obs import annotate as _annotate
+from ..obs import recompile as _recompile
+from ..utils.timer import FunctionTimer
 from .predict import (EnsembleArrays, _path_matrix, decide_raw,
                       stack_ensemble_host)
 from .tree import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, Tree
@@ -348,6 +353,7 @@ class FusedPredictor:
         scores = np.empty(n, dtype=np.float64)
         leaves = (np.empty((n, self.n_trees), dtype=np.int32)
                   if want_leaf else None)
+        tele = _telemetry_active()
         for lo in range(0, n, top):
             chunk = X[lo:lo + top]
             nc = len(chunk)
@@ -356,10 +362,26 @@ class FusedPredictor:
                 chunk = np.concatenate(
                     [chunk, np.zeros((bucket - nc,) + chunk.shape[1:],
                                      dtype=chunk.dtype)])
-            out = predict_blocked(self.ens, jnp.asarray(chunk),
-                                  early_stop_margin=float(early_stop_margin),
-                                  round_period=int(round_period),
-                                  want_leaf=want_leaf)
+            t0 = time.perf_counter()
+            with FunctionTimer("Predict::Fused(dispatch)"), \
+                    _annotate("tree_block_predict"):
+                out = predict_blocked(
+                    self.ens, jnp.asarray(chunk),
+                    early_stop_margin=float(early_stop_margin),
+                    round_period=int(round_period),
+                    want_leaf=want_leaf)
+            # growth of the bucketed dispatch's compiled-program count is a
+            # recompile, attributed to this row bucket: the live form of the
+            # "steady-state serving never recompiles" invariant
+            _recompile.note_dispatch("predict_blocked", bucket,
+                                     predict_compile_count())
+            if tele is not None:
+                dt = time.perf_counter() - t0
+                tele.histogram("predict_dispatch_s_bucket_%d"
+                               % bucket).observe(dt)
+                tele.event("predict", rows=int(nc), bucket=int(bucket),
+                           store=self.kind, trees=int(self.n_trees),
+                           dt_s=dt, want_leaf=bool(want_leaf))
             if want_leaf:
                 leaves[lo:lo + nc] = np.asarray(
                     out[1][:nc, :self.n_trees], dtype=np.int32)

@@ -42,6 +42,8 @@ from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
 from .tree import Tree
 from ..io.binning import BinType, MissingType
 from ..io.dataset import BinnedDataset
+from ..obs import annotate as _annotate
+from ..utils.timer import FunctionTimer
 
 
 class Comm(NamedTuple):
@@ -1435,23 +1437,25 @@ class SerialTreeLearner:
                 else (self.cegb[0], self.cegb[1], self.cegb_used,
                       self.cegb[2]))
         lazy_active = cegb is not None and cegb[3] is not None
-        out = build_tree_partitioned(
-            self.bins, grad, hess,
-            jnp.asarray(num_data_in_bag, dtype=jnp.int32),
-            feature_mask, self.feat,
-            num_leaves=self.num_leaves, max_depth=self.max_depth,
-            params=self.params, num_bins=self.num_bins,
-            use_pallas=self.use_pallas,
-            has_categorical=self.has_categorical,
-            has_monotone=self.has_monotone,
-            feat_num_bins=self.feat_bins,
-            unpack_lanes=self.unpack_lanes,
-            forced=self.forced, cegb=cegb,
-            paid_bits=(self.cegb_paid if lazy_active else None),
-            packed_cols=self.packed_cols,
-            hist_pool_slots=self.hist_pool_slots,
-            bucket_plan=self.bucket_plan,
-            pallas_interpret=self.pallas_interpret)
+        with FunctionTimer("Partition::BuildTree(dispatch)"), \
+                _annotate("partition_build_tree"):
+            out = build_tree_partitioned(
+                self.bins, grad, hess,
+                jnp.asarray(num_data_in_bag, dtype=jnp.int32),
+                feature_mask, self.feat,
+                num_leaves=self.num_leaves, max_depth=self.max_depth,
+                params=self.params, num_bins=self.num_bins,
+                use_pallas=self.use_pallas,
+                has_categorical=self.has_categorical,
+                has_monotone=self.has_monotone,
+                feat_num_bins=self.feat_bins,
+                unpack_lanes=self.unpack_lanes,
+                forced=self.forced, cegb=cegb,
+                paid_bits=(self.cegb_paid if lazy_active else None),
+                packed_cols=self.packed_cols,
+                hist_pool_slots=self.hist_pool_slots,
+                bucket_plan=self.bucket_plan,
+                pallas_interpret=self.pallas_interpret)
         if lazy_active:
             # per-(row, feature) paid bits live for the whole training
             # (feature_used_in_data_)

@@ -9,14 +9,16 @@ from __future__ import annotations
 
 import collections
 import copy
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from . import callback
+from . import callback, obs
 from .basic import Booster, Dataset, LightGBMError
 from .config import alias_transform
 from .utils.log import Log
+from .utils.timer import global_timer
 
 __all__ = ["train", "cv", "CVBooster"]
 
@@ -148,65 +150,121 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     callbacks_after_iter = sorted(callbacks_after_iter,
                                   key=lambda cb: getattr(cb, "order", 0))
 
-    ckpt_freq = int(getattr(booster.config, "snapshot_freq", -1))
-    if checkpoint_prefix is not None:
-        from .parallel.learners import is_write_leader
-        write_ckpt = is_write_leader(booster._booster.mesh)
-        if ckpt_freq <= 0:
-            Log.warning(
-                "checkpoint_prefix is set but snapshot_freq is not (<= 0): "
-                "no checkpoints will be written — pass snapshot_freq in "
-                "params to choose the cadence")
+    # telemetry: a telemetry_out param turns this run self-recording (JSONL
+    # events + <out>.summary.json); a run configured by the caller (bench.py)
+    # is recorded into but finalized by its owner
+    t_out = str(getattr(booster.config, "telemetry_out", "") or "")
+    from .parallel.learners import is_write_leader
+    if t_out and is_write_leader(None):
+        # leader-only like model/checkpoint writes: d pod processes must
+        # not truncate/interleave the same JSONL + summary paths
+        tele = obs.configure(
+            out=t_out, freq=int(getattr(booster.config, "telemetry_freq", 1)),
+            entry="engine.train")
+        own_tele = True
     else:
-        write_ckpt = False
-    for i in range(init_iteration + resumed_iter,
-                   init_iteration + num_boost_round):
-        for cb in callbacks_before_iter:
-            cb(callback.CallbackEnv(model=booster, params=params, iteration=i,
-                                    begin_iteration=init_iteration,
-                                    end_iteration=init_iteration + num_boost_round,
-                                    evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
+        tele = obs.active()
+        own_tele = False
+    t_start = time.perf_counter()
+
+    try:
+        ckpt_freq = int(getattr(booster.config, "snapshot_freq", -1))
+        if checkpoint_prefix is not None:
+            write_ckpt = is_write_leader(booster._booster.mesh)
+            if ckpt_freq <= 0:
+                Log.warning(
+                    "checkpoint_prefix is set but snapshot_freq is not (<= 0): "
+                    "no checkpoints will be written — pass snapshot_freq in "
+                    "params to choose the cadence")
+        else:
+            write_ckpt = False
+        # pre-assign: the loop body may never run (num_boost_round=0, or a
+        # resume that restored the final iteration) yet the epilogue reads it
         evaluation_result_list = []
-        if valid_sets is not None or booster._booster.train_metrics:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(
-                    [(train_data_name, m, v, h)
-                     for (_, m, v, h) in booster.eval_train(feval)])
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after_iter:
-                cb(callback.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=init_iteration,
-                    end_iteration=init_iteration + num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback.EarlyStopException as earlyStopException:
-            booster.best_iteration = earlyStopException.best_iteration + 1
-            evaluation_result_list = earlyStopException.best_score
-            break
-        if (write_ckpt and ckpt_freq > 0
-                and booster._booster.iter_ % ckpt_freq == 0):
-            booster._booster.save_checkpoint(checkpoint_prefix)
-        if finished:
-            break
-    # the trailing < _poll_freq iterations' isfinite reductions
-    # (nan_policy=raise) are only fetched by _poll_stop; drain them here so
-    # a bad batch near the end still raises instead of returning NaN trees
-    booster._booster._drain_nonfinite_checks()
-    if write_ckpt:
-        # this call COMPLETED (ran its rounds or stopped early): drop its
-        # checkpoints so a rerun with the same prefix trains instead of
-        # silently returning the finished run's model.  An interrupted call
-        # never reaches this line — its checkpoints survive for the resume.
-        from .checkpoint import cleanup_checkpoints
-        cleanup_checkpoints(checkpoint_prefix)
-    booster.best_score = collections.defaultdict(collections.OrderedDict)
-    for data_name, eval_name, e_val, _ in (evaluation_result_list or []):
-        booster.best_score[data_name][eval_name] = e_val
-    if booster.best_iteration <= 0:
-        booster.best_iteration = booster.current_iteration()
-    return booster
+        for i in range(init_iteration + resumed_iter,
+                       init_iteration + num_boost_round):
+            for cb in callbacks_before_iter:
+                cb(callback.CallbackEnv(model=booster, params=params, iteration=i,
+                                        begin_iteration=init_iteration,
+                                        end_iteration=init_iteration + num_boost_round,
+                                        evaluation_result_list=None))
+            it_t0 = time.perf_counter() if tele is not None else 0.0
+            finished = booster.update(fobj=fobj)
+            if tele is not None and (i + 1 - init_iteration) % tele.freq == 0:
+                dt_it = time.perf_counter() - it_t0
+                n_rows = int(booster._booster.num_data)
+                tele.histogram("iteration_dispatch_s").observe(dt_it)
+                tele.histogram("chunk_rows_per_s").observe(
+                    n_rows / dt_it if dt_it > 0 else 0.0)
+                tele.event("iteration", iteration=int(i), dt_s=dt_it,
+                           rows_per_s=(n_rows / dt_it if dt_it > 0 else 0.0))
+            evaluation_result_list = []
+            if valid_sets is not None or booster._booster.train_metrics:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(
+                        [(train_data_name, m, v, h)
+                         for (_, m, v, h) in booster.eval_train(feval)])
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after_iter:
+                    cb(callback.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=init_iteration,
+                        end_iteration=init_iteration + num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback.EarlyStopException as earlyStopException:
+                booster.best_iteration = earlyStopException.best_iteration + 1
+                evaluation_result_list = earlyStopException.best_score
+                break
+            if (write_ckpt and ckpt_freq > 0
+                    and booster._booster.iter_ % ckpt_freq == 0):
+                booster._booster.save_checkpoint(checkpoint_prefix)
+            if finished:
+                break
+        # the trailing < _poll_freq iterations' isfinite reductions
+        # (nan_policy=raise) are only fetched by _poll_stop; drain them here so
+        # a bad batch near the end still raises instead of returning NaN trees
+        booster._booster._drain_nonfinite_checks()
+        if write_ckpt:
+            # this call COMPLETED (ran its rounds or stopped early): drop its
+            # checkpoints so a rerun with the same prefix trains instead of
+            # silently returning the finished run's model.  An interrupted call
+            # never reaches this line — its checkpoints survive for the resume.
+            from .checkpoint import cleanup_checkpoints
+            cleanup_checkpoints(checkpoint_prefix)
+        booster.best_score = collections.defaultdict(collections.OrderedDict)
+        for data_name, eval_name, e_val, _ in (evaluation_result_list or []):
+            booster.best_score[data_name][eval_name] = e_val
+        if booster.best_iteration <= 0:
+            booster.best_iteration = booster.current_iteration()
+        if tele is not None:
+            wall = time.perf_counter() - t_start
+            b = booster._booster
+            # iterations trained by THIS call (a checkpoint resume restored
+            # `resumed_iter` of them before the loop; the wall covers only the
+            # post-restore work, so must the iter count)
+            iters_run = int(b.iter_) - int(resumed_iter)
+            tele.gauge("train_rows").set(int(b.num_data))
+            tele.gauge("train_iterations").set(iters_run)
+            tele.gauge("train_wall_s").set(wall)
+            if own_tele:
+                from .obs.report import finalize_run
+                finalize_run(tele, gbdt=b, wall_s=wall, iters=iters_run)
+                # this call OWNS the run: close it so a later train() in the
+                # same process (refits, CV loops, notebooks) doesn't append
+                # events past run_end or clobber the headline gauges
+                obs.disable()
+        # reference exit-time dump at the end of the training driver too
+        # (Log.debug-gated on verbosity)
+        global_timer.print()
+        return booster
+    finally:
+        # exception path (nan_policy=raise, user fobj/callback
+        # errors): the owned run must not stay process-active —
+        # close it so a later train() cannot leak into the artifact
+        if own_tele and obs.active() is tele:
+            obs.disable()
+
 
 
 class CVBooster:

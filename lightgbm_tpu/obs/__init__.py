@@ -1,0 +1,65 @@
+"""Unified telemetry: metrics registry, JSONL events, recompile accounting,
+trace annotations, MFU estimation, end-of-run reports.
+
+The observability layer the reference ships as layer 0
+(``Common::Timer``/``global_timer``, common.h:1032-1093) rebuilt for the
+TPU runtime: one ACTIVE :class:`~.registry.Telemetry` instance per process
+(``configure`` / ``active`` / ``disable``), consulted by the training,
+inference and checkpoint paths at chunk/dispatch granularity.  With no
+instance configured — the default — every instrumentation site is a
+``None`` check and the hot loops make zero telemetry calls (pinned by
+tests/test_telemetry.py).
+
+Enable from any entry point with the ``telemetry_out`` (JSONL path) and
+``telemetry_freq`` (per-iteration event cadence) params; ``engine.train``,
+the CLI and ``bench.py`` all finalize the run into
+``<telemetry_out>.summary.json`` via :func:`~.report.finalize_run`.
+Recompile accounting (:mod:`.recompile`) is the one always-on piece: it
+costs an integer compare per dispatch and is what turns the "steady-state
+serving never recompiles" invariant into a readable gauge.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from . import recompile  # noqa: F401  (re-export)
+from .registry import (EVENT_SCHEMA_VERSION, Counter, Gauge, Histogram,
+                       MetricsRegistry, Telemetry, read_events,
+                       validate_event)
+from .trace import annotate
+
+__all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "EVENT_SCHEMA_VERSION", "read_events", "validate_event",
+           "configure", "active", "disable", "annotate", "recompile"]
+
+_lock = threading.Lock()
+_active: Optional[Telemetry] = None
+
+
+def configure(out: Optional[str] = None, freq: int = 1,
+              **meta: Any) -> Telemetry:
+    """Install the process-active telemetry run (closing any previous one).
+    ``out`` is the JSONL sink path (None keeps events in memory); extra
+    kwargs land on the ``run_start`` event."""
+    global _active
+    tele = Telemetry(out=out, freq=freq, meta=meta)
+    with _lock:
+        prev, _active = _active, tele
+    if prev is not None:
+        prev.close()
+    return tele
+
+
+def active() -> Optional[Telemetry]:
+    """The process-active telemetry run, or None (telemetry off)."""
+    return _active
+
+
+def disable() -> None:
+    """Close and clear the active telemetry run."""
+    global _active
+    with _lock:
+        prev, _active = _active, None
+    if prev is not None:
+        prev.close()

@@ -1,0 +1,106 @@
+"""Recompile accounting: jit cache misses per (function, shape-bucket).
+
+PR 3's "steady-state serving never recompiles" invariant was pinned by one
+jit cache-hit test; this makes it a live gauge anyone can read in
+production.  Dispatch sites report their jitted function's compiled-program
+count after each call (``note_dispatch``) or record a known compile
+directly (``record``); growth is attributed to the shape bucket the call
+used, so ``counts()`` reads like::
+
+    {("predict_blocked", "8192"): 1, ("fused_train", "k=16"): 2}
+
+Counting is ALWAYS on — the cost is one integer compare per *dispatch*
+(never per row or per iteration), which is what lets tests and the
+multichip dryrun assert the gauge stays flat without configuring a
+telemetry run.  When a telemetry run IS active, misses also bump its
+``recompiles`` counter so the JSONL artifact carries them.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+_lock = threading.Lock()
+_counts: Dict[Tuple[str, str], int] = {}
+_last_sizes: Dict[str, int] = {}
+
+
+def note_dispatch(fn_name: str, bucket, cache_size: int,
+                  watch: Optional[str] = None) -> int:
+    """Attribute growth of ``fn_name``'s compiled-program count since the
+    last call to ``bucket``; returns the number of new compiles (0 on a
+    cache hit).  Call AFTER the dispatch with e.g. ``fn._cache_size()``.
+
+    ``watch`` identifies the watched CACHE when several distinct jitted
+    callables report under the same ``fn_name`` (e.g. one sharded-predict
+    fn per mesh): each callable's cache grows from zero, so sharing one
+    baseline would swallow real compiles.  Defaults to ``fn_name``.
+
+    Concurrency caveat: the cache size is sampled AFTER the dispatch, so
+    two threads compiling different buckets of one shared cache at once
+    may attribute each other's compile to the wrong bucket — the TOTAL is
+    exact (what the steady-state==0 invariant pins); per-bucket counts
+    are exact only for serial dispatch."""
+    cache_size = int(cache_size)
+    watch_key = watch or fn_name
+    with _lock:
+        last = _last_sizes.get(watch_key, 0)
+        # track the OBSERVED size, not a high-water mark: after a cache
+        # clear (jax.clear_caches on a long-lived host) the size drops and
+        # the re-compiles that follow are real — a max() baseline would
+        # hide them until the cache regrew past its historical peak
+        _last_sizes[watch_key] = cache_size
+        delta = cache_size - last
+        if delta <= 0:
+            return 0
+        key = (fn_name, str(bucket))
+        _counts[key] = _counts.get(key, 0) + delta
+    _mirror(fn_name, bucket, delta)
+    return delta
+
+
+def record(fn_name: str, bucket, n: int = 1) -> None:
+    """Record ``n`` known compiles directly (host-side program caches that
+    are plain dicts, e.g. GBDT's fused-chunk cache)."""
+    with _lock:
+        key = (fn_name, str(bucket))
+        _counts[key] = _counts.get(key, 0) + int(n)
+    _mirror(fn_name, bucket, int(n))
+
+
+def _mirror(fn_name: str, bucket, n: int) -> None:
+    from . import active
+    tele = active()
+    if tele is not None:
+        tele.counter("recompiles").inc(n)
+        tele.event("recompile", fn=fn_name, bucket=str(bucket), n=n)
+
+
+def counts() -> Dict[Tuple[str, str], int]:
+    with _lock:
+        return dict(_counts)
+
+
+def total(fn_name: Optional[str] = None) -> int:
+    with _lock:
+        return sum(n for (f, _), n in _counts.items()
+                   if fn_name is None or f == fn_name)
+
+
+def reset() -> None:
+    """Zero the counters — call after warmup to pin a steady-state loop at
+    zero.  The watched cache sizes keep their baselines (only GROWTH from
+    now on counts), and an active telemetry run's per-run baseline is
+    re-zeroed so post-reset compiles still show in its summary."""
+    with _lock:
+        _counts.clear()
+    from . import active
+    tele = active()
+    if tele is not None and hasattr(tele, "recompile_baseline"):
+        tele.recompile_baseline = {}
+
+
+def as_flat_dict() -> Dict[str, int]:
+    """{"fn|bucket": n} — the summary-JSON form."""
+    with _lock:
+        return {"%s|%s" % k: n for k, n in sorted(_counts.items())}

@@ -1,0 +1,166 @@
+"""End-of-run telemetry summary: JSON artifact + human table.
+
+The summary is shaped like the BENCH_r*.json trajectory entries this repo's
+perf history uses (``metric``/``value``/``unit`` headline + named
+sub-sections), so ``bench.py``, ``tools/head_to_head.py`` and the PERF.md
+hardware protocols can consume a telemetry artifact directly: one flag
+(``telemetry_out=...``) turns ANY run into a BENCH artifact.
+
+Layout::
+
+    {
+      "v": 1, "metric": "telemetry_run", "unit": "row-trees/s",
+      "value": <overall row-trees/s or null>,
+      "iterations": N, "rows": N, "wall_s": ...,
+      "rows_per_s": {histogram summary},        # per-chunk training rate
+      "ns_per_row": {histogram summary},
+      "host_phases": {"scope": seconds, ...},   # global_timer snapshot
+      "counters": {...}, "gauges": {...}, "histograms": {...},
+      "recompiles": {"fn|bucket": n}, "recompile_total": n,
+      "mfu": x|null, "device_util": y|null,
+      "events": <event count>
+    }
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from . import recompile
+from .registry import EVENT_SCHEMA_VERSION, Telemetry
+
+
+def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Any]:
+    """Fold a run's registry + recompile counters into the summary dict."""
+    from ..utils.timer import global_timer
+    snap = tele.registry.snapshot()
+    hists = snap["histograms"]
+    gauges = snap["gauges"]
+    rows = gauges.get("train_rows")
+    iters = gauges.get("train_iterations")
+    wall = gauges.get("train_wall_s")
+    rows = int(rows) if rows is not None else None
+    iters = int(iters) if iters is not None else None
+    value = None
+    if rows and iters and wall:
+        value = rows * iters / wall
+    # host phases scoped to THIS run: global_timer totals minus the
+    # snapshot taken when the Telemetry was constructed (a second run in
+    # the same process must not inherit the first run's scope time)
+    base = getattr(tele, "timer_baseline", {})
+    phases = {}
+    for name, tot in global_timer.totals().items():
+        delta = tot - base.get(name, 0.0)
+        if delta > 1e-9:
+            phases[name] = delta
+    # recompiles likewise scoped to THIS run (an obs.recompile.reset()
+    # after the baseline — bench/dryrun warmup — only shrinks counts, so
+    # missing/negative deltas clamp to the post-reset values)
+    rc_base = getattr(tele, "recompile_baseline", {})
+    run_recompiles = {}
+    for key, n in recompile.counts().items():
+        delta = n - rc_base.get(key, 0)
+        if delta > 0:
+            run_recompiles["%s|%s" % key] = delta
+    out: Dict[str, Any] = {
+        "v": EVENT_SCHEMA_VERSION,
+        "metric": "telemetry_run",
+        "unit": "row-trees/s",
+        "value": value,
+        "iterations": iters,
+        "rows": rows,
+        "wall_s": wall,
+        "rows_per_s": hists.get("chunk_rows_per_s", {"count": 0}),
+        "ns_per_row": hists.get("chunk_ns_per_row", {"count": 0}),
+        "host_phases": phases,
+        "counters": snap["counters"],
+        "gauges": gauges,
+        "histograms": hists,
+        "recompiles": run_recompiles,
+        "recompile_total": sum(run_recompiles.values()),
+        "mfu": gauges.get("mfu"),
+        "device_util": gauges.get("device_util"),
+        "events": getattr(tele, "event_count", len(tele.events)),
+    }
+    if extra:
+        out.update(extra)
+    return out
+
+
+def human_table(summary: Dict[str, Any]) -> str:
+    """Render a summary dict as the end-of-run report table."""
+    lines = ["telemetry summary"]
+
+    def row(k, v):
+        lines.append("  %-34s %s" % (k, v))
+
+    def num(v, fmt="%.6g"):
+        return "-" if v is None else (fmt % v)
+
+    row("row-trees/s", num(summary.get("value"), "%.1f"))
+    row("iterations", num(summary.get("iterations"), "%d")
+        if summary.get("iterations") is not None else "-")
+    row("wall_s", num(summary.get("wall_s")))
+    row("mfu", num(summary.get("mfu")))
+    row("device_util", num(summary.get("device_util")))
+    row("recompiles (total)", "%d" % summary.get("recompile_total", 0))
+    for key, n in sorted((summary.get("recompiles") or {}).items()):
+        row("  recompile %s" % key, "%d" % n)
+    for name, h in sorted((summary.get("histograms") or {}).items()):
+        if h.get("count"):
+            row(name, "n=%d p50=%.6g p99=%.6g sum=%.6g"
+                % (h["count"], h.get("p50", float("nan")),
+                   h.get("p99", float("nan")), h.get("sum", 0.0)))
+    phases = summary.get("host_phases") or {}
+    if phases:
+        lines.append("  host phases:")
+        for name, tot in sorted(phases.items(), key=lambda kv: -kv[1]):
+            row("    " + name, "%.6f s" % tot)
+    counters = summary.get("counters") or {}
+    for name, v in sorted(counters.items()):
+        row("counter " + name, "%d" % v)
+    return "\n".join(lines)
+
+
+def finalize_run(tele: Telemetry, gbdt=None, wall_s: Optional[float] = None,
+                 iters: Optional[int] = None,
+                 extra: Optional[Dict[str, Any]] = None,
+                 summary_path: Optional[str] = None) -> Dict[str, Any]:
+    """Close out a telemetry run: record headline gauges, the MFU estimate
+    (when a booster is at hand), write ``<out>.summary.json`` next to the
+    JSONL, emit a ``run_end`` event, and return the summary dict.
+
+    Gauges the training driver already recorded WIN: ``GBDT.train`` times
+    the train loop only, while a CLI caller's ``wall_s`` spans dataset
+    loading and compile too — overwriting would make the same training
+    produce different row-trees/s headlines per entry point.  The
+    ``wall_s``/``iters`` arguments are the fallback for runs that never
+    went through a recording driver (bench's timed window)."""
+    from ..utils.log import Log
+    if wall_s is not None and tele.gauge("train_wall_s").value is None:
+        tele.gauge("train_wall_s").set(wall_s)
+    if iters is not None and tele.gauge("train_iterations").value is None:
+        tele.gauge("train_iterations").set(iters)
+    eff_wall = tele.gauge("train_wall_s").value
+    eff_iters = tele.gauge("train_iterations").value
+    if gbdt is not None:
+        if tele.gauge("train_rows").value is None:
+            tele.gauge("train_rows").set(int(gbdt.num_data))
+        if eff_wall:
+            from .mfu import record_training_estimate
+            record_training_estimate(
+                tele, gbdt, eff_wall,
+                iters=int(eff_iters) if eff_iters else None)
+    summary = summarize(tele, extra=extra)
+    tele.event("run_end", wall_s=wall_s, iterations=iters)
+    path = summary_path
+    if path is None and tele.out_path:
+        path = tele.out_path + ".summary.json"
+    if path:
+        from ..utils.file_io import atomic_write
+        atomic_write(path, json.dumps(summary, indent=1, default=str))
+        Log.info("Wrote telemetry summary %s", path)
+    tele.flush()
+    Log.debug("%s", human_table(summary))
+    return summary

@@ -111,7 +111,12 @@ def sharded_predict(ens, rows: np.ndarray, mesh: Optional[Mesh] = None, *,
     (``shape_bucket``); batches beyond the top bucket stream through it in
     fixed-shape chunks (rows are independent), keeping the no-recompile
     contract per shard at ANY batch size."""
+    import time as _time
+
     from ..core.predict_fused import PREDICT_BUCKETS, shape_bucket
+    from ..obs import active as _telemetry_active
+    from ..obs import annotate as _annotate
+    from ..obs import recompile as _recompile
     mesh = mesh if mesh is not None else default_mesh()
     d = int(np.prod(mesh.devices.shape))
     rows = np.asarray(rows)
@@ -121,15 +126,29 @@ def sharded_predict(ens, rows: np.ndarray, mesh: Optional[Mesh] = None, *,
     fn = sharded_predict_fn(mesh, early_stop_margin, round_period)
     top = PREDICT_BUCKETS[-1] * d
     scores = np.empty(n, dtype=np.float64)
+    tele = _telemetry_active()
     for lo in range(0, max(n, 1), top):
         chunk = rows[lo:lo + top]
         nc = len(chunk)
-        n_pad = shape_bucket(-(-nc // d)) * d
+        bucket = shape_bucket(-(-nc // d))
+        n_pad = bucket * d
         if n_pad > nc:
             chunk = np.concatenate(
                 [chunk, np.zeros((n_pad - nc,) + chunk.shape[1:],
                                  dtype=chunk.dtype)])
-        out = fn(ens, jnp.asarray(chunk))
+        t0 = _time.perf_counter()
+        with _annotate("sharded_predict"):
+            out = fn(ens, jnp.asarray(chunk))
+        # one jitted fn per (mesh, early-stop config), each with its OWN
+        # jit cache growing from zero: watch them separately (by callable
+        # identity — fns are cached for the process lifetime) so a second
+        # mesh's compiles aren't swallowed by the first's larger baseline
+        _recompile.note_dispatch(
+            "sharded_predict(m=%g,p=%d)" % (early_stop_margin, round_period),
+            bucket, fn._cache_size(), watch="sharded_predict/%d" % id(fn))
+        if tele is not None:
+            tele.event("sharded_predict", rows=int(nc), bucket=int(bucket),
+                       shards=int(d), dt_s=_time.perf_counter() - t0)
         scores[lo:lo + nc] = np.asarray(out[:nc], dtype=np.float64)
     return scores
 

@@ -2,39 +2,80 @@
 
 Counterpart of the reference's ``Common::Timer``/``FunctionTimer``/``global_timer``
 (include/LightGBM/utils/common.h:1032-1093): hot host paths are instrumented with
-RAII-style scopes whose accumulated times can be printed at exit.  Device-side
-profiling is jax.profiler's job; this covers the host orchestration only.
+RAII-style scopes whose accumulated times are printed at process exit (and at
+the end of ``engine.train``) when verbosity reaches debug, matching the
+reference's exit-time dump.  Device-side profiling is jax.profiler's job; this
+covers the host orchestration only.
+
+Scopes STACK: nested/overlapping ``start(name)`` on the same key no longer
+drops the outer scope — each ``stop`` closes the most recent open scope of
+that name (per thread), so re-entrant instrumentation (a timed function
+calling itself, or two threads sharing ``global_timer``) accumulates every
+scope's elapsed time.  Start stacks are thread-local; the totals map is
+lock-protected.
 """
 from __future__ import annotations
 
+import atexit
+import threading
 import time
 from collections import OrderedDict
 from contextlib import ContextDecorator
+from typing import Dict, List
 
 
 class Timer:
     def __init__(self) -> None:
-        self._starts: "OrderedDict[str, float]" = OrderedDict()
+        self._local = threading.local()
+        self._lock = threading.Lock()
         self._totals: "OrderedDict[str, float]" = OrderedDict()
+        # bumped by reset(): start stacks are thread-local, so reset cannot
+        # reach another thread's in-flight scope — instead each scope
+        # records the epoch it opened in and stop() discards scopes that
+        # straddle a reset
+        self._epoch = 0
+
+    def _starts(self) -> Dict[str, List[tuple]]:
+        starts = getattr(self._local, "starts", None)
+        if starts is None:
+            starts = self._local.starts = {}
+        return starts
 
     def start(self, name: str) -> None:
-        self._starts[name] = time.perf_counter()
+        self._starts().setdefault(name, []).append(
+            (self._epoch, time.perf_counter()))
 
     def stop(self, name: str) -> None:
-        if name in self._starts:
-            self._totals[name] = self._totals.get(name, 0.0) + (
-                time.perf_counter() - self._starts.pop(name))
+        stack = self._starts().get(name)
+        if stack:
+            epoch, t0 = stack.pop()
+            dt = time.perf_counter() - t0
+            with self._lock:
+                # epoch compared under the SAME lock reset() bumps it in:
+                # a scope straddling a concurrent reset is discarded, not
+                # added to the freshly-zeroed totals
+                if epoch != self._epoch:
+                    return
+                self._totals[name] = self._totals.get(name, 0.0) + dt
 
     def total(self, name: str) -> float:
-        return self._totals.get(name, 0.0)
+        with self._lock:
+            return self._totals.get(name, 0.0)
+
+    def totals(self) -> Dict[str, float]:
+        """Snapshot of all accumulated scope totals (seconds)."""
+        with self._lock:
+            return dict(self._totals)
 
     def reset(self) -> None:
-        self._starts.clear()
-        self._totals.clear()
+        self._starts().clear()
+        with self._lock:
+            self._totals.clear()
+            self._epoch += 1
 
     def summary(self) -> str:
         lines = ["LightGBM-TPU host timing summary:"]
-        for name, tot in sorted(self._totals.items(), key=lambda kv: -kv[1]):
+        for name, tot in sorted(self.totals().items(), key=lambda kv: -kv[1]):
             lines.append("  %s: %.6f s" % (name, tot))
         return "\n".join(lines)
 
@@ -44,6 +85,15 @@ class Timer:
 
 
 global_timer = Timer()
+
+
+@atexit.register
+def _print_at_exit() -> None:
+    """The reference dumps global_timer when the process ends
+    (common.h:1089-1093 ~Timer); Log.debug keeps it gated on
+    verbosity >= debug like every other debug line."""
+    if global_timer.totals():
+        global_timer.print()
 
 
 class FunctionTimer(ContextDecorator):

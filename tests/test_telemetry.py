@@ -1,0 +1,481 @@
+"""Telemetry subsystem (lightgbm_tpu/obs): registry semantics, JSONL
+schema round-trip, per-iteration cadence, recompile accounting pinned at
+zero in steady state, zero-overhead-when-off, and the stacked Timer fix.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs.registry import (EVENT_SCHEMA_VERSION, Histogram,
+                                       MetricsRegistry, Telemetry,
+                                       read_events, validate_event)
+from lightgbm_tpu.utils.timer import FunctionTimer, Timer
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    """Every test starts and ends with telemetry off."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _toy_booster(n=2048, num_iterations=8, seed=0, **params):
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, 8))
+    y = X[:, 0] * 1.5 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=n)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=16)
+    cfg = Config(objective="regression", num_leaves=15, min_data_in_leaf=5,
+                 num_iterations=num_iterations, **params)
+    return GBDT(cfg, ds, create_objective("regression", cfg)), X, y
+
+
+# ---- registry semantics ----
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(4)
+    assert reg.counter("c").value == 5
+    reg.gauge("g").set(2.5)
+    reg.gauge("g").set(7.0)
+    assert reg.gauge("g").value == 7.0
+    h = reg.histogram("h")
+    for v in range(100):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 100 and s["min"] == 0.0 and s["max"] == 99.0
+    assert s["p50"] == pytest.approx(50.0, abs=1.0)
+    assert s["p99"] == pytest.approx(98.0, abs=1.0)
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == 5
+    assert snap["gauges"]["g"] == 7.0
+    assert snap["histograms"]["h"]["count"] == 100
+
+
+def test_histogram_empty_and_single():
+    h = Histogram()
+    assert h.summary() == {"count": 0, "sum": 0.0}
+    h.observe(3.0)
+    s = h.summary()
+    assert s["p50"] == 3.0 and s["p99"] == 3.0 and s["mean"] == 3.0
+
+
+# ---- JSONL schema round-trip ----
+
+def test_jsonl_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "tele.jsonl")
+    tele = Telemetry(out=path, freq=3, meta={"entry": "test"})
+    tele.event("iteration", iteration=1, dt_s=0.5)
+    with tele.time_block("timed"):
+        pass
+    tele.close()
+    events = read_events(path)
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["run_start", "iteration", "timed"]
+    for e in events:
+        assert e["v"] == EVENT_SCHEMA_VERSION
+        validate_event(e)
+    assert events[0]["entry"] == "test"
+    assert events[1]["iteration"] == 1
+    assert events[2]["dt_s"] >= 0.0
+    # in-memory mirror matches the file
+    assert [e["kind"] for e in tele.events] == kinds
+
+
+def test_jsonl_schema_rejects_bad_events(tmp_path):
+    with pytest.raises(ValueError):
+        validate_event({"ts": 1.0, "kind": "x"})  # no version
+    with pytest.raises(ValueError):
+        validate_event({"v": EVENT_SCHEMA_VERSION, "ts": "no", "kind": "x"})
+    with pytest.raises(ValueError):
+        validate_event({"v": EVENT_SCHEMA_VERSION, "ts": 1.0, "kind": ""})
+    # mid-file corruption raises...
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"v": 1, "ts": 1.0, "kind": "ok"}\nnot json\n'
+                   '{"v": 1, "ts": 2.0, "kind": "ok"}\n')
+    with pytest.raises(ValueError):
+        read_events(str(bad))
+    # ...but a torn FINAL line (writer killed mid-event) is dropped so a
+    # preempted run's artifact stays readable
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text('{"v": 1, "ts": 1.0, "kind": "ok"}\n{"v": 1, "ts": 2.')
+    evs = read_events(str(torn))
+    assert len(evs) == 1 and evs[0]["kind"] == "ok"
+
+
+# ---- per-iteration event cadence vs telemetry_freq ----
+
+@pytest.mark.parametrize("freq,expected", [(1, 10), (2, 5), (3, 3)])
+def test_engine_train_iteration_cadence(tmp_path, freq, expected):
+    from lightgbm_tpu import engine
+    from lightgbm_tpu.basic import Dataset
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(600, 5))
+    y = X[:, 0] + rng.normal(scale=0.1, size=600)
+    out = str(tmp_path / "t.jsonl")
+    engine.train({"objective": "regression", "num_leaves": 7,
+                  "min_data_in_leaf": 5, "verbosity": -1,
+                  "telemetry_out": out, "telemetry_freq": freq},
+                 Dataset(X, label=y), num_boost_round=10)
+    events = read_events(out)
+    its = [e for e in events if e["kind"] == "iteration"]
+    assert len(its) == expected
+    # engine.train finalized the run: summary JSON sits next to the JSONL
+    with open(out + ".summary.json") as fh:
+        summary = json.load(fh)
+    assert summary["iterations"] == 10
+    assert summary["value"] is not None and summary["value"] > 0
+    obs.disable()
+
+
+def test_engine_train_closes_run_on_exception(tmp_path):
+    """An error mid-train must not leak the engine-owned run: the next run
+    in the process starts from obs.active() is None."""
+    from lightgbm_tpu import engine
+    from lightgbm_tpu.basic import Dataset
+
+    def bad_fobj(score, ds):
+        raise RuntimeError("user objective blew up")
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(400, 4))
+    y = X[:, 0]
+    out = str(tmp_path / "aborted.jsonl")
+    with pytest.raises(RuntimeError):
+        engine.train({"objective": "none", "num_leaves": 7, "verbosity": -1,
+                      "telemetry_out": out}, Dataset(X, label=y),
+                     num_boost_round=3, fobj=bad_fobj)
+    assert obs.active() is None, "aborted run leaked as process-active"
+    # the JSONL was closed (flushed) — whatever was recorded is readable
+    for e in read_events(out):
+        validate_event(e)
+
+
+# ---- summary artifact contents (acceptance shape) ----
+
+def test_summary_artifact_contents(tmp_path):
+    """One run with telemetry_out set produces schema-valid JSONL + a
+    summary with rows/s, host phases, checkpoint latencies, recompile
+    counts per shape bucket and the MFU estimate fields."""
+    out = str(tmp_path / "run.jsonl")
+    tele = obs.configure(out=out, freq=1, entry="test")
+    booster, X, _ = _toy_booster(num_iterations=6, snapshot_freq=2,
+                                 snapshot_keep=0)
+    booster.train(snapshot_out=str(tmp_path / "model.txt"))
+    booster.predict(X[:600])  # per-bucket predict latency + recompile note
+    from lightgbm_tpu.obs.report import finalize_run, human_table
+    summary = finalize_run(tele, gbdt=booster, wall_s=1.0,
+                           iters=int(booster.iter_))
+    for e in read_events(out):
+        validate_event(e)
+    # per-iteration rows/s (chunk granularity on the fused driver)
+    assert summary["rows_per_s"]["count"] >= 1
+    # per-phase host dispatch times
+    assert any("TrainChunk" in k or "Train" in k
+               for k in summary["host_phases"])
+    assert "Checkpoint::Write" in summary["host_phases"]
+    # checkpoint latencies
+    assert summary["histograms"]["checkpoint_write_s"]["count"] >= 1
+    # per-shape-bucket predict latency
+    assert any(k.startswith("predict_dispatch_s_bucket_")
+               for k in summary["histograms"])
+    # recompile counts are keyed per (function, shape bucket)
+    assert any(k.startswith("fused_train|") for k in summary["recompiles"])
+    # MFU estimate fields present (ratios None off-accelerator, but the
+    # analytic flop/byte gauges must be there)
+    assert "mfu" in summary and "device_util" in summary
+    assert summary["gauges"]["est_macs"] > 0
+    assert summary["gauges"]["est_bytes"] > 0
+    # the driver's train-loop gauges win over finalize_run's wall_s arg
+    assert summary["wall_s"] != 1.0
+    assert summary["value"] == pytest.approx(
+        booster.num_data * booster.iter_ / summary["wall_s"])
+    text = human_table(summary)
+    assert "row-trees/s" in text and "recompiles (total)" in text
+
+
+# ---- recompile accounting ----
+
+def test_recompile_zero_across_steady_state_predict():
+    booster, X, _ = _toy_booster(num_iterations=4)
+    booster.train_chunk(4)
+    booster.predict(X[:600])       # warmup: pad-to-1024 bucket compile
+    obs.recompile.reset()
+    for n in (600, 700, 1024, 130):  # 1024-bucket and 128-bucket reuse...
+        booster.predict(X[:n])
+    booster.predict(X[:600])
+    assert obs.recompile.total("predict_blocked") == 0, \
+        obs.recompile.counts()
+
+
+def test_recompile_zero_across_fused_training_steady_state():
+    booster, _, _ = _toy_booster(num_iterations=16, metric_freq=4)
+    booster.train_chunk(4)         # compiles the k=4 fused program
+    obs.recompile.reset()
+    booster.train_chunk(4)         # same config-keyed chunk: cache hit
+    booster.train_chunk(4)
+    assert obs.recompile.total("fused_train") == 0, obs.recompile.counts()
+    # a NEW chunk length is a legitimate compile and must be attributed
+    booster.train_chunk(2)
+    assert obs.recompile.counts().get(("fused_train", "k=2")) == 1
+
+
+def test_recompile_baseline_follows_cache_clear():
+    """After a jit-cache clear the observed size drops; growth from the
+    NEW size must count (a high-water baseline would hide the storm)."""
+    obs.recompile.reset()
+    obs.recompile.note_dispatch("fn_clear", 1, 3)
+    assert obs.recompile.total("fn_clear") == 3
+    obs.recompile.note_dispatch("fn_clear", 1, 1)   # cache cleared
+    obs.recompile.note_dispatch("fn_clear", 1, 2)   # real recompile
+    assert obs.recompile.counts()[("fn_clear", "1")] == 4
+
+
+def test_engine_train_zero_iterations_after_full_resume(tmp_path):
+    """A resume that restored the final iteration runs the loop zero times;
+    the epilogue must not crash (and the model must be intact)."""
+    from lightgbm_tpu import engine
+    from lightgbm_tpu.basic import Booster, Dataset
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(600, 5))
+    y = X[:, 0] + rng.normal(scale=0.1, size=600)
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+              "snapshot_freq": 4}
+    b = Booster(params=dict(params), train_set=Dataset(X, label=y))
+    for _ in range(4):
+        b.update()
+    prefix = str(tmp_path / "full")
+    b.save_checkpoint(prefix)
+    out = engine.train(dict(params), Dataset(X, label=y),
+                       num_boost_round=4, checkpoint_prefix=prefix,
+                       verbose_eval=False)
+    assert out.current_iteration() == 4
+
+
+def test_recompile_note_dispatch_attribution():
+    obs.recompile.reset()
+    base = obs.recompile.total()
+    assert base == 0
+    obs.recompile.note_dispatch("fn_x", 128, 1)   # may or may not grow
+    first = obs.recompile.total("fn_x")
+    obs.recompile.note_dispatch("fn_x", 128, 1)   # same size: no growth
+    assert obs.recompile.total("fn_x") == first
+    obs.recompile.note_dispatch("fn_x", 1024, 3)  # +2 at the 1024 bucket
+    assert obs.recompile.counts()[("fn_x", "1024")] == 2
+
+
+def test_recompiles_scoped_per_run():
+    """A second telemetry run must not inherit the first run's recompile
+    counts (process-global counters, per-run baseline)."""
+    from lightgbm_tpu.obs.report import summarize
+    obs.recompile.record("fn_scoped", "b1")
+    tele1 = obs.configure(freq=1)
+    obs.recompile.record("fn_scoped", "b1", 2)
+    s1 = summarize(tele1)
+    assert s1["recompiles"].get("fn_scoped|b1") == 2, s1["recompiles"]
+    tele2 = obs.configure(freq=1)  # fresh run: baseline includes all 3
+    s2 = summarize(tele2)
+    assert "fn_scoped|b1" not in s2["recompiles"]
+    assert s2["recompile_total"] == 0
+    # a reset inside the run re-zeroes the baseline: later compiles show
+    obs.recompile.reset()
+    obs.recompile.record("fn_scoped", "b1")
+    s3 = summarize(tele2)
+    assert s3["recompiles"].get("fn_scoped|b1") == 1
+
+
+def test_host_phases_scoped_per_run():
+    from lightgbm_tpu.obs.report import summarize
+    from lightgbm_tpu.utils.timer import global_timer
+    global_timer.start("phase_scoped")
+    time.sleep(0.02)
+    global_timer.stop("phase_scoped")
+    tele = obs.configure(freq=1)
+    s = summarize(tele)
+    assert "phase_scoped" not in s["host_phases"]
+    global_timer.start("phase_scoped")
+    time.sleep(0.02)
+    global_timer.stop("phase_scoped")
+    s2 = summarize(tele)
+    assert 0.01 < s2["host_phases"]["phase_scoped"] < 1.0
+
+
+def test_resumed_run_iterations_not_inflated(tmp_path):
+    """A checkpoint-resumed run's telemetry counts only the iterations it
+    trained (its wall covers only this process)."""
+    from lightgbm_tpu.checkpoint import load_checkpoint
+    b1, _, _ = _toy_booster(num_iterations=4, snapshot_freq=2,
+                            snapshot_keep=0, metric_freq=10)
+    prefix = str(tmp_path / "m.txt")
+    b1.train(snapshot_out=prefix)
+    meta, arrays, model_str = load_checkpoint(prefix + ".ckpt_iter_2")
+    b2, _, _ = _toy_booster(num_iterations=4, snapshot_freq=2,
+                            snapshot_keep=0, metric_freq=10)
+    b2.restore_train_state(meta, arrays, model_str)
+    assert b2.iter_ == 2
+    tele = obs.configure(freq=1)
+    b2.train(None)
+    assert b2.iter_ == 4
+    assert tele.gauge("train_iterations").value == 2  # not 4
+
+
+# ---- zero-overhead when off ----
+
+def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch):
+    """With telemetry disabled (the default), a fused-scan training run and
+    a predict loop must record NOTHING: no events, no metric touches."""
+    calls = []
+
+    def spy(name):
+        orig = getattr(Telemetry, name)
+
+        def wrapper(self, *a, **k):
+            calls.append((name, a))
+            return orig(self, *a, **k)
+        return wrapper
+
+    for name in ("event", "counter", "gauge", "histogram", "time_block"):
+        monkeypatch.setattr(Telemetry, name, spy(name))
+    assert obs.active() is None
+    booster, X, _ = _toy_booster(num_iterations=8)
+    booster.train_chunk(8)
+    booster.predict(X[:600])
+    booster.train(None)  # the driver path too
+    assert calls == [], "telemetry-off run made %d telemetry calls: %r" % (
+        len(calls), calls[:5])
+
+
+def test_telemetry_off_no_events_attr_left():
+    booster, _, _ = _toy_booster(num_iterations=4)
+    assert obs.active() is None
+    booster.train_chunk(4)
+    # configure AFTER: nothing from the earlier run may leak in
+    tele = obs.configure(freq=1)
+    assert [e["kind"] for e in tele.events] == ["run_start"]
+
+
+# ---- C-ABI impl layer ----
+
+def test_c_api_telemetry_impls(tmp_path):
+    from lightgbm_tpu.c_api import (_impl_telemetry_configure,
+                                    _impl_telemetry_disable,
+                                    _impl_telemetry_recompile_count,
+                                    _impl_telemetry_summary)
+    assert _impl_telemetry_summary() == ""
+    out = str(tmp_path / "capi.jsonl")
+    _impl_telemetry_configure(out, 2)
+    tele = obs.active()
+    assert tele is not None and tele.freq == 2
+    tele.gauge("train_rows").set(10)
+    s = json.loads(_impl_telemetry_summary())
+    assert s["metric"] == "telemetry_run" and s["rows"] == 10
+    assert _impl_telemetry_recompile_count() >= 0
+    _impl_telemetry_disable()
+    assert obs.active() is None
+    assert _impl_telemetry_summary() == ""
+
+
+# ---- Timer stacking / re-entrancy (satellite fix) ----
+
+def test_timer_nested_same_name_scopes_stack():
+    t = Timer()
+    t.start("a")
+    time.sleep(0.02)
+    t.start("a")          # nested scope on the SAME key
+    time.sleep(0.02)
+    t.stop("a")           # closes the inner scope (~0.02)
+    inner = t.total("a")
+    assert inner >= 0.015
+    t.stop("a")           # closes the OUTER scope (~0.04) — was dropped
+    assert t.total("a") >= inner + 0.03
+
+
+def test_timer_function_timer_reentrant():
+    t = Timer()
+
+    @FunctionTimer("f", timer=t)
+    def rec(n):
+        if n:
+            time.sleep(0.01)
+            rec(n - 1)
+
+    rec(3)
+    # 4 nested scopes of ~30/20/10/0 ms: total ~60ms, NOT just the leaf
+    assert t.total("f") >= 0.05
+
+
+def test_timer_threads_do_not_cross():
+    t = Timer()
+
+    def work(ms):
+        t.start("w")
+        time.sleep(ms / 1000.0)
+        t.stop("w")
+
+    threads = [threading.Thread(target=work, args=(20,)) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # each thread closed its OWN scope: ~4 * 20ms accumulated
+    assert t.total("w") >= 0.06
+    assert t.totals() == {"w": t.total("w")}
+
+
+def test_timer_stop_without_start_is_noop():
+    t = Timer()
+    t.stop("nope")
+    assert t.total("nope") == 0.0
+    assert "nope" not in t.totals()
+
+
+def test_timer_reset_discards_other_threads_inflight_scopes():
+    """A scope opened before reset() (possibly on another thread, which
+    reset's thread-local clear cannot reach) must not pollute the fresh
+    totals when it closes after the reset."""
+    t = Timer()
+    opened = threading.Event()
+    go = threading.Event()
+
+    def work():
+        t.start("x")
+        opened.set()
+        go.wait(timeout=5)
+        t.stop("x")   # closes AFTER the main thread's reset
+
+    th = threading.Thread(target=work)
+    th.start()
+    opened.wait(timeout=5)
+    t.reset()
+    go.set()
+    th.join()
+    assert t.total("x") == 0.0, "pre-reset scope leaked into fresh totals"
+
+
+# ---- nan_policy trips reach the telemetry counters ----
+
+def test_nan_trip_counter(tmp_path):
+    from lightgbm_tpu.utils.log import Log
+    tele = obs.configure(freq=1)
+    booster, _, _ = _toy_booster(num_iterations=3, nan_policy="clip")
+    n = booster.num_data
+    bad = np.full((1, n), np.nan, dtype=np.float32)
+    good = np.ones((1, n), dtype=np.float32)
+    lvl = Log._level
+    Log.reset_level(Log.Level.FATAL)
+    try:
+        booster.train_one_iter(bad, good)
+    finally:
+        Log.reset_level(lvl)
+    assert tele.counter("nan_policy_trips").value == 1
+    kinds = [e["kind"] for e in tele.events]
+    assert "nan_trip" in kinds

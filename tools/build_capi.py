@@ -152,6 +152,11 @@ int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx, int leaf_idx,
     double val);
 int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
     int importance_type, double* out_results);
+int LGBM_TelemetryConfigure(const char* out_path, int freq);
+int LGBM_TelemetryDisable();
+int LGBM_TelemetrySummary(int64_t buffer_len, int64_t* out_len,
+    char* out_str);
+int LGBM_TelemetryRecompileCount(int64_t* out_count);
 int LGBM_NetworkInit(const char* machines, int local_listen_port,
     int listen_time_out, int num_machines);
 int LGBM_NetworkFree();

@@ -141,8 +141,15 @@ def run_size(rows, iters, threads, skip_ref=False, skip_tpu=False):
             env = {"LIGHTGBM_TPU_CACHE_DIR": cache_dir}
             cold, aucs = run_cli(cli + ["config=" + conf_path],
                                  "%s_%d_cold" % (tag, rows), env)
-            warm, aucs_w = run_cli(cli + ["config=" + conf_path],
-                                   "%s_%d_warm" % (tag, rows), env)
+            # the WARM run is self-recording: its telemetry artifact
+            # (per-chunk rows/s, host phases, recompile counts, MFU) is
+            # the measurement the report points at — CLI key=value args
+            # win over config-file lines, so the config stays shared
+            warm, aucs_w = run_cli(
+                cli + ["config=" + conf_path,
+                       "telemetry_out=%s/%s_%d_telemetry.jsonl"
+                       % (WORK, tag, rows)],
+                "%s_%d_warm" % (tag, rows), env)
             results[tag] = ((cold, warm), aucs)
             print("  %s: cold %.1f s / warm %.1f s, AUC trail %s"
                   % (tag, cold, warm, aucs[-3:]), flush=True)
@@ -211,8 +218,18 @@ def run_predict(rows, iters, threads, skip_ref=False, skip_tpu=False):
             env = {"LIGHTGBM_TPU_CACHE_DIR": cache_dir}
             cold, _ = run_cli(cli + ["config=" + conf_path],
                               "%s_pred_%d_cold" % (tag, rows), env)
-            warm, _ = run_cli(cli + ["config=" + conf_path],
-                              "%s_pred_%d_warm" % (tag, rows), env)
+            # warm predict run self-records per-bucket latencies and the
+            # recompile gauge.  NOTE: the gauge counts this fresh
+            # process's in-process jit cache, so the first pass over the
+            # bucket ladder legitimately registers one compile per bucket
+            # (the persistent cache only skips XLA re-compilation);
+            # "steady state never recompiles" means no FURTHER growth
+            # within the run — see the recompile events' timestamps
+            warm, _ = run_cli(
+                cli + ["config=" + conf_path,
+                       "telemetry_out=%s/%s_pred_%d_telemetry.jsonl"
+                       % (WORK, tag, rows)],
+                "%s_pred_%d_warm" % (tag, rows), env)
         else:
             cold, _ = run_cli(cli + ["config=" + conf_path],
                               "%s_pred_%d" % (tag, rows))
@@ -310,7 +327,12 @@ def write_report(args, threads, all_results):
         "(`tools/head_to_head.py`%s).  Cold = fresh "
         "persistent-compilation-cache (pays XLA/Mosaic compiles); warm = "
         "second identical invocation (executables load from the cache; "
-        "numerically identical trajectory, asserted)."
+        "numerically identical trajectory, asserted).  The warm "
+        "lightgbm_tpu run is SELF-RECORDING "
+        "(`telemetry_out=/tmp/h2h/lightgbm_tpu_<rows>_telemetry.jsonl` + "
+        "`.summary.json` alongside): per-chunk rows/s, host dispatch "
+        "phases, recompile counts and the MFU estimate ride the artifact "
+        "instead of ad-hoc timing — render with `tools/obs_report.py`."
         % (args.iters,
            " --regime small" if getattr(args, "regime", "") == "small"
            else ""),

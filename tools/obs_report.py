@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL (lightgbm_tpu/obs) into human/trace artifacts.
+
+Any run with ``telemetry_out=<path>`` set (engine.train, the CLI,
+bench.py) writes a schema-versioned JSONL event stream plus
+``<path>.summary.json``.  This tool turns those into things people read:
+
+- the end-of-run human table (``obs.report.human_table``) — from the
+  written summary when present, else rebuilt from the events;
+- a Chrome-trace/Perfetto JSON (``--trace out.json``): every event
+  carrying a duration (``dt_s``) becomes a complete ("X") slice anchored
+  at its start timestamp, everything else an instant event — load it in
+  ``chrome://tracing`` / https://ui.perfetto.dev to see the host
+  dispatch timeline (fused chunks, predict buckets, checkpoint writes)
+  of a production run.
+
+No device work, no import-time allocation: heavy imports happen inside
+``main`` after argparse has answered ``--help``.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(
+        description="render a lightgbm_tpu telemetry JSONL into the human "
+                    "summary table and/or a Chrome-trace file")
+    ap.add_argument("jsonl", help="telemetry JSONL path (telemetry_out=...)")
+    ap.add_argument("--summary", default=None,
+                    help="summary JSON to render (default: <jsonl>"
+                         ".summary.json when present, else rebuilt from "
+                         "the events)")
+    ap.add_argument("--trace", default=None, metavar="OUT",
+                    help="write a Chrome-trace/Perfetto JSON built from "
+                         "the event timestamps to OUT")
+    ap.add_argument("--no-table", action="store_true",
+                    help="skip printing the human summary table")
+    return ap
+
+
+def events_to_chrome_trace(events):
+    """Telemetry events -> Chrome trace-event JSON (ts/dur in microseconds).
+
+    Events with a ``dt_s`` field become complete slices anchored at their
+    recorded start (``t0`` when present, else ``ts - dt_s``); the rest are
+    instant events.  Scalar payload fields ride along as args."""
+    out = []
+    for e in events:
+        args = {k: v for k, v in e.items()
+                if k not in ("v", "ts", "kind", "dt_s", "t0")
+                and isinstance(v, (int, float, str, bool))}
+        dt = e.get("dt_s")
+        if isinstance(dt, (int, float)) and dt >= 0:
+            t0 = e.get("t0")
+            if not isinstance(t0, (int, float)):
+                t0 = e["ts"] - dt
+            out.append({"name": e["kind"], "ph": "X", "ts": t0 * 1e6,
+                        "dur": dt * 1e6, "pid": 0, "tid": 0, "args": args})
+        else:
+            out.append({"name": e["kind"], "ph": "i", "s": "g",
+                        "ts": e["ts"] * 1e6, "pid": 0, "tid": 0,
+                        "args": args})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def summary_from_events(events):
+    """Rebuild a renderable summary dict from raw events (for JSONL files
+    whose run died before finalize_run wrote the summary)."""
+    from lightgbm_tpu.obs.registry import Histogram
+    hists = {}
+    counters = {}
+    recompiles = {}
+    for e in events:
+        counters[e["kind"]] = counters.get(e["kind"], 0) + 1
+        dt = e.get("dt_s")
+        if isinstance(dt, (int, float)):
+            hists.setdefault(e["kind"] + "_s", Histogram()).observe(dt)
+        if e["kind"] == "recompile":
+            # one event can carry n>1 compiles (a cache that grew by
+            # several programs in one dispatch)
+            key = "%s|%s" % (e.get("fn", "?"), e.get("bucket", "?"))
+            recompiles[key] = recompiles.get(key, 0) + int(e.get("n", 1))
+    return {
+        "metric": "telemetry_run", "unit": "row-trees/s", "value": None,
+        "iterations": None, "wall_s": None,
+        "recompiles": recompiles,
+        "recompile_total": sum(recompiles.values()),
+        "histograms": {k: h.summary() for k, h in sorted(hists.items())},
+        "counters": {"events_" + k: v for k, v in sorted(counters.items())},
+        "host_phases": {}, "gauges": {},
+        "mfu": None, "device_util": None, "events": len(events),
+    }
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from lightgbm_tpu.obs.registry import read_events
+    from lightgbm_tpu.obs.report import human_table
+    events = read_events(args.jsonl)
+    if args.trace:
+        with open(args.trace, "w") as fh:
+            json.dump(events_to_chrome_trace(events), fh)
+        print("wrote %s (%d trace events)" % (args.trace, len(events)),
+              file=sys.stderr)
+    if not args.no_table:
+        summary_path = args.summary
+        if summary_path is None:
+            cand = args.jsonl + ".summary.json"
+            summary_path = cand if os.path.exists(cand) else None
+        if summary_path:
+            with open(summary_path) as fh:
+                summary = json.load(fh)
+        else:
+            summary = summary_from_events(events)
+        print(human_table(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
