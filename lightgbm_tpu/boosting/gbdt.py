@@ -38,6 +38,8 @@ from ..objective import ObjectiveFunction, create_objective
 from ..obs import active as _telemetry_active
 from ..obs import annotate as _annotate
 from ..obs import recompile as _recompile
+from ..resilience import preemption_requested as _preemption_requested
+from ..resilience import watch as _watch
 from ..utils.file_io import atomic_write
 from ..utils.log import LightGBMError, Log
 from ..utils.timer import FunctionTimer
@@ -278,8 +280,9 @@ class GBDT:
         self._last_poll = self.iter_
         if not self._nl_handles and not self._fin_handles:
             return False
-        fetched = jax.device_get([h for _, _, h in self._nl_handles]
-                                 + [f for _, f in self._fin_handles])
+        with _watch("poll_stop", iteration=int(self.iter_)):
+            fetched = jax.device_get([h for _, _, h in self._nl_handles]
+                                     + [f for _, f in self._fin_handles])
         nls = fetched[:len(self._nl_handles)]
         fins = fetched[len(self._nl_handles):]
         bad = [it for (it, _), ok in zip(self._fin_handles, fins)
@@ -983,7 +986,9 @@ class GBDT:
                        for kk in range(self.num_tree_per_iteration)]
         t0 = time.perf_counter()
         with FunctionTimer("GBDT::TrainChunk(dispatch)"), \
-                _annotate("fused_train_chunk"):
+                _annotate("fused_train_chunk"), \
+                _watch("fused_train_chunk", compile_key=int(num_iters),
+                       first_iter=int(self.iter_), iters=int(num_iters)):
             new_score, new_vscores, stacked = fn(
                 self.train_score,
                 tuple(vs["score"] for vs in self.valid_sets),
@@ -1326,9 +1331,15 @@ class GBDT:
             # run could never trim below the checkpoint — breaking
             # bit-exactness exactly when training stalls near a boundary
             self._poll_stop()
+        from ..checkpoint import dataset_fingerprint
         meta = {
             "boosting": type(self).__name__.lower(),
             "iteration": int(self.iter_),
+            # dataset identity + live row count: the resume-vs-wrong-data
+            # guard and the elastic (d -> d') reshard both key on these
+            "num_data": int(self.num_data),
+            "dataset": (dataset_fingerprint(self.train_data)
+                        if self.train_data is not None else None),
             "num_init_iteration": int(self.num_init_iteration),
             "shrinkage_rate": float(self.shrinkage_rate),
             "bag_rng": encode_rng_state(self._bag_rng),
@@ -1371,12 +1382,58 @@ class GBDT:
                 "checkpoint validation sets %r do not match the attached "
                 "ones %r — attach the same valid sets in the same order "
                 "before restoring" % (names, have))
+        # resume-vs-wrong-data guard: a checkpoint resumed against a
+        # DIFFERENT dataset silently trains garbage (the restored score
+        # caches describe rows that no longer exist) — hard-error instead
+        saved_fp = meta.get("dataset")
+        if saved_fp is not None and self.train_data is not None:
+            from ..checkpoint import dataset_fingerprint
+            cur_fp = dataset_fingerprint(self.train_data)
+            diff = [k for k in ("num_rows", "num_features", "bin_digest")
+                    if saved_fp.get(k) != cur_fp.get(k)]
+            if diff:
+                raise CheckpointError(
+                    "checkpoint was written against a different dataset "
+                    "(%s) — resume needs the same training data"
+                    % ", ".join("%s: %r != %r" % (k, saved_fp.get(k),
+                                                  cur_fp.get(k))
+                                for k in diff))
         ts = np.asarray(arrays["train_score"])
         if tuple(ts.shape) != tuple(self.train_score.shape):
-            raise CheckpointError(
-                "checkpoint train_score shape %r does not match this "
-                "dataset/learner layout %r — resume needs the same training "
-                "data" % (tuple(ts.shape), tuple(self.train_score.shape)))
+            # elastic resume: the same dataset under a different device
+            # count pads the row axis differently ([K, n + pad_d] vs
+            # [K, n + pad_d']).  Only the first num_data columns are ever
+            # read (gradients, metrics); the pad tail holds routing debris
+            # no consumer looks at — so reshard: keep the live columns,
+            # re-zero the new pad.  Same-d resume never reaches this branch
+            # and stays byte-identical.
+            n = self.num_data
+            saved_rows = int(meta.get("num_data",
+                                      (saved_fp or {}).get("num_rows", -1)))
+            if (saved_rows == n and ts.shape[0] == self.train_score.shape[0]
+                    and ts.shape[1] >= n):
+                pad = self.train_score.shape[1] - n
+                ts = np.concatenate(
+                    [ts[:, :n], np.zeros((ts.shape[0], pad), ts.dtype)],
+                    axis=1)
+                Log.warning(
+                    "elastic resume: checkpoint score layout %r resharded "
+                    "to %r (device count / row padding changed; the %d live "
+                    "rows carry over, pad rows re-zeroed)",
+                    tuple(np.asarray(arrays["train_score"]).shape),
+                    tuple(self.train_score.shape), n)
+                tele = _telemetry_active()
+                if tele is not None:
+                    tele.event("elastic_resume", num_data=int(n),
+                               saved_cols=int(np.asarray(
+                                   arrays["train_score"]).shape[1]),
+                               new_cols=int(self.train_score.shape[1]))
+            else:
+                raise CheckpointError(
+                    "checkpoint train_score shape %r does not match this "
+                    "dataset/learner layout %r — resume needs the same "
+                    "training data"
+                    % (tuple(ts.shape), tuple(self.train_score.shape)))
         # resume assumes the SAME run continuing; differing params mean a
         # stale checkpoint or an edited command — warn loudly, don't guess
         saved_params = meta.get("params")
@@ -1410,7 +1467,15 @@ class GBDT:
         if "cegb_used" in arrays and getattr(ln, "cegb_used", None) is not None:
             ln.cegb_used = jnp.asarray(np.asarray(arrays["cegb_used"]))
         if "cegb_paid" in arrays and getattr(ln, "cegb_paid", None) is not None:
-            ln.cegb_paid = jnp.asarray(np.asarray(arrays["cegb_paid"]))
+            paid = np.asarray(arrays["cegb_paid"])
+            want_rows = int(ln.cegb_paid.shape[0])
+            if paid.shape[0] != want_rows and paid.shape[0] >= self.num_data:
+                # elastic resume: per-row paid bits follow the score reshard
+                # (live rows carry over, pad rows re-zeroed)
+                out = np.zeros((want_rows,) + paid.shape[1:], paid.dtype)
+                out[:self.num_data] = paid[:self.num_data]
+                paid = out
+            ln.cegb_paid = jnp.asarray(paid)
         # rebuild the bagging mask for the in-progress window: the stateless
         # hash (_bag_uniforms) regenerates the window-start mask exactly
         cfg = self.config
@@ -1645,6 +1710,13 @@ class GBDT:
                 finished = self.eval_and_check_early_stopping()
             if finished:
                 break
+            if _preemption_requested():
+                # SIGTERM/SIGINT landed (possibly mid-chunk): the poll sits
+                # at the chunk boundary — the in-flight fused program
+                # completed whole (no mid-chunk tear) — and AFTER the
+                # boundary eval, so the emergency checkpoint carries the
+                # same early-stopping bookkeeping a periodic one would
+                self._preempt_exit(snapshot_out)
             if (snapshot_out and sf > 0 and self.iter_ % sf == 0):
                 # settle the stall poll BEFORE capturing so the checkpoint
                 # never contains iterations a later poll would trim; a trim
@@ -1669,16 +1741,45 @@ class GBDT:
             tele.gauge("train_iterations").set(int(self.iter_ - it_start))
             tele.gauge("train_wall_s").set(time.perf_counter() - t_start)
 
+    def _preempt_exit(self, snapshot_out: Optional[str]) -> None:
+        """Preemption flag set: drain in-flight device work (settle the
+        stall poll, fetch pending isfinite reductions), write a
+        leader-gated emergency checkpoint through the ordinary atomic
+        path, and raise :class:`TrainingPreempted` so the driver exits
+        with the distinct resumable code."""
+        from ..resilience import (TrainingPreempted, clear_preemption,
+                                  emergency_checkpoint)
+        if self._nl_handles:
+            self._poll_stop()
+        if self._fin_handles:
+            self._drain_nonfinite_checks()
+        path = None
+        if snapshot_out:
+            path = emergency_checkpoint(self, snapshot_out)
+        # the preemption is now fully handled — consume the flag so a later
+        # train() in this process (the in-process resume) starts clean
+        # instead of instantly re-preempting
+        clear_preemption()
+        raise TrainingPreempted(int(self.iter_), path)
+
     def _write_snapshot(self, snapshot_out: str) -> None:
         """Periodic durability point: the reference-compatible model snapshot
         (gbdt.cpp:291-295) plus a full train-state checkpoint, both written
         atomically, retained last-``snapshot_keep``, and only by the mesh
-        leader (d hosts must not race the same rename)."""
+        leader (d hosts must not race the same rename).  Both writes are
+        best-effort: transient faults retried inside ``atomic_write``, a
+        fatal fault (disk full) skips THIS snapshot and keeps training —
+        the previous checkpoint remains the resume point."""
+        from ..checkpoint import save_checkpoint_best_effort, skip_io_failure
         from ..parallel.learners import is_write_leader
         if not is_write_leader(self.mesh):
             return
-        self.save_model("%s.snapshot_iter_%d" % (snapshot_out, self.iter_))
-        self.save_checkpoint(snapshot_out)
+        snap = "%s.snapshot_iter_%d" % (snapshot_out, self.iter_)
+        try:
+            self.save_model(snap)
+        except OSError as exc:
+            skip_io_failure("model snapshot %s" % snap, exc)
+        save_checkpoint_best_effort(self, snapshot_out)
 
     # ---- evaluation ----
 

@@ -310,6 +310,28 @@ def _impl_telemetry_recompile_count() -> int:
     return int(recompile.total())
 
 
+def _impl_preemption_install() -> None:
+    """Arm the SIGTERM/SIGINT preemption flag (resilience.py): embedding
+    hosts driving training through the C ABI get the same graceful
+    chunk-boundary shutdown as engine.train/the CLI.  The host polls
+    ``LGBM_PreemptionRequested`` (or lets a LightGBMError surface from the
+    update loop via TrainingPreempted)."""
+    from .resilience import install_preemption_handler
+    install_preemption_handler()
+
+
+def _impl_preemption_requested() -> int:
+    from .resilience import preemption_requested
+    return 1 if preemption_requested() else 0
+
+
+def _impl_predict_fallback_count() -> int:
+    """Total degraded-serving activations (resilience.note_fallback) —
+    always-on, readable without a telemetry run, like the recompile gauge."""
+    from .resilience import fallback_counts
+    return int(sum(fallback_counts().values()))
+
+
 def _impl_predict_for_file(cb: _CBooster, data_filename: str,
                            data_has_header: int, predict_type: int,
                            num_iteration: int, parameter: str,
@@ -882,6 +904,20 @@ def bind(ffi) -> None:  # noqa: C901 - one registration block
     @export("LGBM_TelemetryRecompileCount")
     def _(out_count):
         out_count[0] = _impl_telemetry_recompile_count()
+
+    # ---- resilience (lightgbm_tpu/resilience.py) ----
+
+    @export("LGBM_PreemptionInstall")
+    def _():
+        _impl_preemption_install()
+
+    @export("LGBM_PreemptionRequested")
+    def _(out_flag):
+        out_flag[0] = _impl_preemption_requested()
+
+    @export("LGBM_PredictFallbackCount")
+    def _(out_count):
+        out_count[0] = _impl_predict_fallback_count()
 
     # ---- network shims (network.cpp -> XLA collectives; see SURVEY §2.3) ----
 

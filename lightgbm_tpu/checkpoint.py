@@ -33,6 +33,7 @@ import glob
 import json
 import os
 import re
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -63,6 +64,34 @@ def list_checkpoints(prefix: str) -> List[Tuple[int, str]]:
         if m:
             out.append((int(m.group(1)), path))
     return sorted(out, reverse=True)
+
+
+# ---- dataset identity ----
+
+def dataset_fingerprint(ds) -> Dict[str, Any]:
+    """Cheap identity of a ``BinnedDataset``: row/feature counts plus a
+    CRC32 digest of every feature's bin mapper (bounds, categories, types).
+
+    A checkpoint resumed against a *different* dataset silently trains
+    garbage — the restored score caches describe rows that no longer
+    exist; the fingerprint turns that into a hard error.  Deterministic
+    for a given input (binning is deterministic), so rebuilding the same
+    dataset in the resume process matches byte-for-byte."""
+    crc = zlib.crc32(np.asarray(
+        [ds.num_data, ds.num_total_features], dtype=np.int64).tobytes())
+    for m in ds.bin_mappers:
+        crc = zlib.crc32(np.asarray(
+            [int(m.num_bin), int(m.bin_type), int(m.missing_type),
+             int(m.default_bin)], dtype=np.int64).tobytes(), crc)
+        if m.bin_2_categorical:
+            crc = zlib.crc32(np.asarray(m.bin_2_categorical,
+                                        dtype=np.int64).tobytes(), crc)
+        else:
+            crc = zlib.crc32(np.asarray(m.bin_upper_bound,
+                                        dtype=np.float64).tobytes(), crc)
+    return {"num_rows": int(ds.num_data),
+            "num_features": int(ds.num_total_features),
+            "bin_digest": "%08x" % (crc & 0xFFFFFFFF)}
 
 
 # ---- RNG state (np.random.RandomState <-> JSON) ----
@@ -173,6 +202,33 @@ def save_checkpoint(booster, prefix: str, keep: Optional[int] = None) -> str:
         keep = int(getattr(booster.config, "snapshot_keep", 0))
     prune_checkpoints(prefix, keep)
     return path
+
+
+def skip_io_failure(what: str, exc: OSError) -> None:
+    """Record a skipped best-effort durability write: periodic snapshots
+    are an optimization, not correctness — disk-full must not kill a
+    healthy training run.  The previous checkpoint stays the resume point."""
+    Log.warning("%s failed (%s); training continues — periodic durability "
+                "writes are best-effort and the previous checkpoint remains "
+                "the resume point", what, exc)
+    from .obs import active as _telemetry_active
+    tele = _telemetry_active()
+    if tele is not None:
+        tele.counter("checkpoint_skipped").inc()
+        tele.event("checkpoint_skipped", what=what, error=str(exc)[:300])
+
+
+def save_checkpoint_best_effort(booster, prefix: str,
+                                keep: Optional[int] = None) -> Optional[str]:
+    """:func:`save_checkpoint` with the periodic-write policy: transient
+    faults were already retried inside ``atomic_write``; what still raises
+    is fatal for THIS write (``ENOSPC``, permissions) but not for the run —
+    log + count + return ``None`` so the training loop continues."""
+    try:
+        return save_checkpoint(booster, prefix, keep=keep)
+    except OSError as exc:
+        skip_io_failure("checkpoint write %s" % prefix, exc)
+        return None
 
 
 def load_checkpoint(path: str

@@ -123,10 +123,32 @@ class Application:
         if obs.active() is tele:
             obs.disable()
 
+    def _arm_resilience(self):
+        """Install the supervision layer the config asks for: the
+        SIGTERM/SIGINT preemption flag (``preemption_checkpoint=true``,
+        task=train only) and the stalled-dispatch watchdog
+        (``watchdog_timeout_s > 0``).  One shared policy with engine.train
+        (resilience.arm_supervision); returns its ownership pair for
+        :meth:`_disarm_resilience`."""
+        from . import resilience
+        cfg = self.config
+        preempt = bool(getattr(cfg, "preemption_checkpoint", False)) \
+            and cfg.task == "train"
+        base = (str(getattr(cfg, "telemetry_out", "") or "")
+                or cfg.output_model or None)
+        return resilience.arm_supervision(
+            preempt, float(getattr(cfg, "watchdog_timeout_s", 0.0)),
+            artifact_base=base)
+
+    def _disarm_resilience(self, owned_handler: bool, own_wd: bool) -> None:
+        from . import resilience
+        resilience.disarm_supervision(owned_handler, own_wd)
+
     def train(self) -> None:
         import time
         cfg = self.config
         tele = self._configure_telemetry()
+        preempt, own_wd = self._arm_resilience()
         t_start = time.perf_counter()
         try:
             loader = DatasetLoader(cfg)
@@ -144,7 +166,12 @@ class Application:
             # the restore itself waits until the valid sets are attached (their
             # score caches ride the checkpoint).
             ckpt_state = None
-            if cfg.snapshot_freq > 0 and cfg.output_model:
+            resumable = (cfg.snapshot_freq > 0
+                         or getattr(cfg, "preemption_checkpoint", False))
+            if resumable and cfg.output_model:
+                # preemption_checkpoint runs are resumable even without
+                # periodic snapshots: the emergency checkpoint written at
+                # SIGTERM is discovered the same way
                 from .checkpoint import load_latest_checkpoint
                 ckpt_state = load_latest_checkpoint(cfg.output_model)
             if ckpt_state is None and cfg.input_model:
@@ -164,13 +191,22 @@ class Application:
                 from .checkpoint import restore_state
                 restore_state(booster, ckpt_state)
             it_start = int(booster.iter_)  # nonzero on a checkpoint resume
-            booster.train(snapshot_out=cfg.output_model)
+            from .resilience import EXIT_PREEMPTED, TrainingPreempted
+            try:
+                booster.train(snapshot_out=cfg.output_model)
+            except TrainingPreempted as exc:
+                # the emergency checkpoint is on disk (leader): exit with
+                # the distinct code so a supervisor reruns this command to
+                # resume instead of treating the run as failed
+                Log.warning("%s; exiting with code %d (resumable)", exc,
+                            EXIT_PREEMPTED)
+                raise SystemExit(EXIT_PREEMPTED)
             from .parallel.learners import is_write_leader
             if is_write_leader(getattr(booster, "mesh", None)):
                 # same leader-only write discipline as the in-loop snapshots:
                 # d hosts must not race the final rename or the cleanup unlinks
                 booster.save_model(cfg.output_model)
-                if cfg.snapshot_freq > 0 and cfg.output_model:
+                if resumable and cfg.output_model:
                     # the run COMPLETED: drop its checkpoints so a rerun of
                     # this command trains fresh instead of resuming a finished
                     # run
@@ -191,6 +227,7 @@ class Application:
             if cfg.verbosity > 0:
                 global_timer.print()
         finally:
+            self._disarm_resilience(preempt, own_wd)
             self._close_telemetry(tele)
 
     # ---- task=predict (application.cpp:215-252, predictor.hpp) ----
@@ -202,6 +239,9 @@ class Application:
             # and a run opened here would leak past the try/finally below
             Log.fatal("Need input_model for prediction task")
         tele = self._configure_telemetry()
+        # the watchdog covers serving dispatch too (sharded_predict
+        # collectives hang exactly like training ones on a dead peer)
+        preempt, own_wd = self._arm_resilience()
         try:
             booster = GBDT.load_model(cfg.input_model, cfg)
             loader = DatasetLoader(cfg)
@@ -228,6 +268,7 @@ class Application:
                 finalize_run(tele, extra={"rows_predicted": int(len(X))})
                 obs.disable()
         finally:
+            self._disarm_resilience(preempt, own_wd)
             self._close_telemetry(tele)
 
     # ---- task=convert_model (gbdt_model_text.cpp:87 ModelToIfElse) ----

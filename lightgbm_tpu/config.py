@@ -258,6 +258,14 @@ class Config:
         if self.nan_policy not in ("raise", "skip_iter", "clip"):
             Log.fatal("Unknown nan_policy %s (expected raise, skip_iter or "
                       "clip)", self.nan_policy)
+        if ("io_retry_attempts" in self.raw_params
+                or "io_retry_backoff_s" in self.raw_params):
+            # the retry policy guards a process-global primitive
+            # (file_io.atomic_write), so an explicit param configures it
+            # process-wide — same ownership model as the telemetry run
+            from .utils.file_io import configure_retries
+            configure_retries(attempts=int(self.io_retry_attempts),
+                              base_delay=float(self.io_retry_backoff_s))
         # seed cascade (config.cpp:205-230): explicit `seed` derives the sub-seeds
         if "seed" in self.raw_params:
             base = int(self.seed)

@@ -301,6 +301,20 @@ def predict_compile_count() -> int:
     return predict_blocked._cache_size()
 
 
+@functools.partial(jax.jit, static_argnames=("early_stop_margin",
+                                             "round_period", "want_leaf"))
+def predict_scan_fallback(blocks, rows, early_stop_margin: float = -1.0,
+                          round_period: int = 10, want_leaf: bool = False):
+    """The degraded-mode predictor: the same scan core over a g=1 blocking
+    (one tree per scan step — the pre-blocking per-tree scan), jitted into
+    its OWN cache so a failure of the big blocked program (bucket compile,
+    corrupted cache entry) cannot poison the fallback.  Bit-exact with the
+    blocked path by the same argument every blocking is (integer hit sums,
+    per-tree f32 add order replayed)."""
+    return scan_blocks(blocks, rows, early_stop_margin=early_stop_margin,
+                       round_period=round_period, want_leaf=want_leaf)
+
+
 class FusedPredictor:
     """Device predictor for one class's tree sequence, stacked ONCE.
 
@@ -321,6 +335,11 @@ class FusedPredictor:
         # keep the layout dataset alive: GBDT's predictor cache keys on
         # id(dataset), which must not be recycled while this entry lives
         self.layout_ds = dataset
+        # degraded-mode serving: the g=1 fallback ensemble is derived from
+        # the blocked one by reshape on first failure (no host trees
+        # retained, no re-stacking; never an exception on the serving path)
+        self._fb_ens = None
+        self._fb_warned = False
         if kind == "raw":
             self.ens = stack_ensemble_blocked(trees) if trees else None
         else:
@@ -363,18 +382,24 @@ class FusedPredictor:
                     [chunk, np.zeros((bucket - nc,) + chunk.shape[1:],
                                      dtype=chunk.dtype)])
             t0 = time.perf_counter()
-            with FunctionTimer("Predict::Fused(dispatch)"), \
-                    _annotate("tree_block_predict"):
-                out = predict_blocked(
-                    self.ens, jnp.asarray(chunk),
-                    early_stop_margin=float(early_stop_margin),
-                    round_period=int(round_period),
-                    want_leaf=want_leaf)
-            # growth of the bucketed dispatch's compiled-program count is a
-            # recompile, attributed to this row bucket: the live form of the
-            # "steady-state serving never recompiles" invariant
-            _recompile.note_dispatch("predict_blocked", bucket,
-                                     predict_compile_count())
+            try:
+                with FunctionTimer("Predict::Fused(dispatch)"), \
+                        _annotate("tree_block_predict"):
+                    out = predict_blocked(
+                        self.ens, jnp.asarray(chunk),
+                        early_stop_margin=float(early_stop_margin),
+                        round_period=int(round_period),
+                        want_leaf=want_leaf)
+                # growth of the bucketed dispatch's compiled-program count
+                # is a recompile, attributed to this row bucket: the live
+                # form of the "steady-state serving never recompiles"
+                # invariant
+                _recompile.note_dispatch("predict_blocked", bucket,
+                                         predict_compile_count())
+            except Exception as exc:  # degraded serving: never an exception
+                out = self._predict_degraded(
+                    jnp.asarray(chunk), bucket, exc,
+                    float(early_stop_margin), int(round_period), want_leaf)
             if tele is not None:
                 dt = time.perf_counter() - t0
                 tele.histogram("predict_dispatch_s_bucket_%d"
@@ -388,3 +413,45 @@ class FusedPredictor:
             else:
                 scores[lo:lo + nc] = np.asarray(out[:nc], dtype=np.float64)
         return leaves if want_leaf else scores
+
+    # ---- degraded mode (resilience): per-tree scan fallback ----
+
+    def _fallback_ens(self):
+        """g=1 re-blocking of the degraded path, built lazily on the first
+        failure (a healthy predictor never pays for it) by RESHAPING the
+        stacked ensemble: [T/G, G, ...] -> [T_pad, 1, ...].  Pad trees stay
+        dead (path_len -1 never matches, leaf values 0) and trail the real
+        ones, so scores, early-stop check positions and the leading
+        ``n_trees`` leaf columns are unchanged — same bit-exactness
+        argument as any other blocking."""
+        if self._fb_ens is None:
+            self._fb_ens = type(self.ens)(*[
+                jnp.reshape(a, (a.shape[0] * a.shape[1], 1) + a.shape[2:])
+                for a in self.ens])
+        return self._fb_ens
+
+    def _predict_degraded(self, rows, bucket: int, exc: Exception,
+                          early_stop_margin: float, round_period: int,
+                          want_leaf: bool):
+        """Serve the chunk through the per-tree scan after the blocked
+        dispatch failed: counted (``resilience.note_fallback`` +
+        ``predict_fallbacks`` telemetry counter), warned once per
+        predictor, bit-exact with the blocked result."""
+        from ..resilience import note_fallback
+        from ..utils.log import Log
+        if not self._fb_warned:
+            self._fb_warned = True
+            Log.warning("fused predict failed for bucket %d (%s: %s); "
+                        "serving DEGRADED via the per-tree scan path",
+                        bucket, type(exc).__name__, exc)
+        note_fallback("predict_blocked", reason="%s: %s"
+                      % (type(exc).__name__, exc), bucket=int(bucket))
+        out = predict_scan_fallback(
+            self._fallback_ens(), rows,
+            early_stop_margin=float(early_stop_margin),
+            round_period=int(round_period), want_leaf=want_leaf)
+        # the fallback's own compiles are recompiles too — a steady-state
+        # degraded loop must also read zero after its first bucket compile
+        _recompile.note_dispatch("predict_fallback", bucket,
+                                 predict_scan_fallback._cache_size())
+        return out

@@ -37,7 +37,8 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
           early_stopping_rounds: Optional[int] = None,
           evals_result: Optional[Dict] = None, verbose_eval=True,
           learning_rates=None, keep_training_booster: bool = False,
-          callbacks=None, checkpoint_prefix: Optional[str] = None) -> Booster:
+          callbacks=None, checkpoint_prefix: Optional[str] = None,
+          preemption_checkpoint: bool = False) -> Booster:
     """Train with given parameters; returns the trained Booster.
 
     ``checkpoint_prefix`` enables the fault-tolerant runtime: the full train
@@ -54,6 +55,15 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     so they restart on resume (the resumed run may stop later than the
     uninterrupted one); the CLI / ``GBDT.train`` driver's internal
     early-stopping state rides the checkpoint and resumes bit-exactly.
+
+    ``preemption_checkpoint=True`` (or the param of the same name) arms the
+    SIGTERM/SIGINT preemption path: the handler sets a flag, the loop polls
+    it at iteration boundaries, writes a leader-gated emergency checkpoint
+    to ``checkpoint_prefix`` and raises
+    :class:`~lightgbm_tpu.resilience.TrainingPreempted` — drivers convert
+    that into exit code ``resilience.EXIT_PREEMPTED`` so a supervisor can
+    tell resumable from failed.  ``watchdog_timeout_s > 0`` additionally
+    arms the dispatch watchdog for the duration of the call.
     """
     params = copy.deepcopy(params) if params else {}
     for alias in _NUM_BOOST_ROUND_ALIASES:
@@ -167,6 +177,20 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         own_tele = False
     t_start = time.perf_counter()
 
+    # resilience supervision (lightgbm_tpu/resilience.py): SIGTERM/SIGINT
+    # -> flag -> emergency checkpoint + TrainingPreempted; a watchdog
+    # timeout arms the stalled-dispatch monitor for this call
+    from . import resilience
+    preempt = bool(preemption_checkpoint) or bool(
+        getattr(booster.config, "preemption_checkpoint", False))
+    if preempt and checkpoint_prefix is None:
+        Log.warning("preemption_checkpoint is set without a "
+                    "checkpoint_prefix: a preempted run exits cleanly "
+                    "but has nothing to resume from")
+    owned_handler, own_wd = resilience.arm_supervision(
+        preempt, float(getattr(booster.config, "watchdog_timeout_s", 0.0)),
+        artifact_base=t_out or checkpoint_prefix)
+
     try:
         ckpt_freq = int(getattr(booster.config, "snapshot_freq", -1))
         if checkpoint_prefix is not None:
@@ -218,7 +242,16 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 break
             if (write_ckpt and ckpt_freq > 0
                     and booster._booster.iter_ % ckpt_freq == 0):
-                booster._booster.save_checkpoint(checkpoint_prefix)
+                # best-effort like every periodic durability write: a
+                # disk-full checkpoint skip must not kill a healthy run
+                from .checkpoint import save_checkpoint_best_effort
+                save_checkpoint_best_effort(booster._booster,
+                                            checkpoint_prefix)
+            if preempt and resilience.preemption_requested():
+                # ONE preempt-exit sequence for every driver: drain
+                # in-flight device work, emergency checkpoint, consume the
+                # flag, raise TrainingPreempted
+                booster._booster._preempt_exit(checkpoint_prefix)
             if finished:
                 break
         # the trailing < _poll_freq iterations' isfinite reductions
@@ -259,6 +292,7 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         global_timer.print()
         return booster
     finally:
+        resilience.disarm_supervision(owned_handler, own_wd)
         # exception path (nan_policy=raise, user fobj/callback
         # errors): the owned run must not stay process-active —
         # close it so a later train() cannot leak into the artifact

@@ -17,6 +17,10 @@ Layout::
       "host_phases": {"scope": seconds, ...},   # global_timer snapshot
       "counters": {...}, "gauges": {...}, "histograms": {...},
       "recompiles": {"fn|bucket": n}, "recompile_total": n,
+      "resilience": {"preemptions": n, "io_retries": n,
+                     "predict_fallbacks": n, "checkpoint_skipped": n,
+                     "preempt_checkpoint_s": {histogram summary},
+                     "watchdog_stall_s": x|null},
       "mfu": x|null, "device_util": y|null,
       "events": <event count>
     }
@@ -63,6 +67,18 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
         delta = n - rc_base.get(key, 0)
         if delta > 0:
             run_recompiles["%s|%s" % key] = delta
+    # resilience rollup (lightgbm_tpu/resilience.py): every fault the run
+    # absorbed, as one named subsection — the drill report reads this
+    counters = snap["counters"]
+    resilience = {
+        "preemptions": int(counters.get("preemptions", 0)),
+        "io_retries": int(counters.get("io_retries", 0)),
+        "predict_fallbacks": int(counters.get("predict_fallbacks", 0)),
+        "checkpoint_skipped": int(counters.get("checkpoint_skipped", 0)),
+        "preempt_checkpoint_s": hists.get("preempt_checkpoint_s",
+                                          {"count": 0}),
+        "watchdog_stall_s": gauges.get("watchdog_stall_s"),
+    }
     out: Dict[str, Any] = {
         "v": EVENT_SCHEMA_VERSION,
         "metric": "telemetry_run",
@@ -79,6 +95,7 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
         "histograms": hists,
         "recompiles": run_recompiles,
         "recompile_total": sum(run_recompiles.values()),
+        "resilience": resilience,
         "mfu": gauges.get("mfu"),
         "device_util": gauges.get("device_util"),
         "events": getattr(tele, "event_count", len(tele.events)),
@@ -107,6 +124,19 @@ def human_table(summary: Dict[str, Any]) -> str:
     row("recompiles (total)", "%d" % summary.get("recompile_total", 0))
     for key, n in sorted((summary.get("recompiles") or {}).items()):
         row("  recompile %s" % key, "%d" % n)
+    res = summary.get("resilience") or {}
+    shown = {k: v for k, v in sorted(res.items())
+             if (isinstance(v, (int, float)) and v)
+             or (isinstance(v, dict) and v.get("count"))}
+    if shown:
+        lines.append("  resilience:")
+        for k, v in shown.items():
+            if isinstance(v, dict):
+                row("    " + k, "n=%d p50=%.6g p99=%.6g"
+                    % (v["count"], v.get("p50", float("nan")),
+                       v.get("p99", float("nan"))))
+            else:
+                row("    " + k, num(v))
     for name, h in sorted((summary.get("histograms") or {}).items()):
         if h.get("count"):
             row(name, "n=%d p50=%.6g p99=%.6g sum=%.6g"

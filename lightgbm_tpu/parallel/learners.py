@@ -117,6 +117,8 @@ def sharded_predict(ens, rows: np.ndarray, mesh: Optional[Mesh] = None, *,
     from ..obs import active as _telemetry_active
     from ..obs import annotate as _annotate
     from ..obs import recompile as _recompile
+    from ..resilience import note_fallback as _note_fallback
+    from ..resilience import watch as _watch
     mesh = mesh if mesh is not None else default_mesh()
     d = int(np.prod(mesh.devices.shape))
     rows = np.asarray(rows)
@@ -137,18 +139,45 @@ def sharded_predict(ens, rows: np.ndarray, mesh: Optional[Mesh] = None, *,
                 [chunk, np.zeros((n_pad - nc,) + chunk.shape[1:],
                                  dtype=chunk.dtype)])
         t0 = _time.perf_counter()
-        with _annotate("sharded_predict"):
-            out = fn(ens, jnp.asarray(chunk))
-        # one jitted fn per (mesh, early-stop config), each with its OWN
-        # jit cache growing from zero: watch them separately (by callable
-        # identity — fns are cached for the process lifetime) so a second
-        # mesh's compiles aren't swallowed by the first's larger baseline
-        _recompile.note_dispatch(
-            "sharded_predict(m=%g,p=%d)" % (early_stop_margin, round_period),
-            bucket, fn._cache_size(), watch="sharded_predict/%d" % id(fn))
+        fell_back = False
+        try:
+            with _annotate("sharded_predict"), \
+                    _watch("sharded_predict", compile_key=int(bucket),
+                           rows=int(nc), bucket=int(bucket), shards=int(d)):
+                out = fn(ens, jnp.asarray(chunk))
+        except Exception as exc:  # mesh unhealthy: serve single-device
+            fell_back = True
+            from ..core.predict_fused import predict_blocked
+            from ..utils.log import Log
+            Log.warning("sharded predict failed on the %d-device mesh "
+                        "(%s: %s); serving DEGRADED on a single device",
+                        d, type(exc).__name__, exc)
+            _note_fallback("sharded_predict", reason="%s: %s"
+                           % (type(exc).__name__, exc),
+                           bucket=int(bucket), shards=int(d))
+            # a FRESH watch section: the failed dispatch's clock must not
+            # bleed into the recovery (the fallback may legitimately spend
+            # a first-dispatch compile here), but a hang of the fallback
+            # itself is still caught
+            with _watch("sharded_predict_fallback", compile_key=int(bucket),
+                        rows=int(nc), bucket=int(bucket)):
+                out = predict_blocked(
+                    ens, jnp.asarray(chunk),
+                    early_stop_margin=float(early_stop_margin),
+                    round_period=int(round_period))
+        if not fell_back:
+            # one jitted fn per (mesh, early-stop config), each with its OWN
+            # jit cache growing from zero: watch them separately (by callable
+            # identity — fns are cached for the process lifetime) so a second
+            # mesh's compiles aren't swallowed by the first's larger baseline
+            _recompile.note_dispatch(
+                "sharded_predict(m=%g,p=%d)" % (early_stop_margin,
+                                                round_period),
+                bucket, fn._cache_size(), watch="sharded_predict/%d" % id(fn))
         if tele is not None:
             tele.event("sharded_predict", rows=int(nc), bucket=int(bucket),
-                       shards=int(d), dt_s=_time.perf_counter() - t0)
+                       shards=int(d), dt_s=_time.perf_counter() - t0,
+                       fallback=bool(fell_back))
         scores[lo:lo + nc] = np.asarray(out[:nc], dtype=np.float64)
     return scores
 

@@ -16,61 +16,185 @@ rename, proving that property.
 """
 from __future__ import annotations
 
+import errno
 import os
+import random
+import threading
+import time
 import zlib
 from typing import Callable, Dict, Optional
 
 _SCHEMES: Dict[str, Callable] = {}
 
-# test/tool hook: called with the stage name ("written", "synced") while the
-# temp file exists but the rename has not happened; raising (or killing the
-# process) from it simulates a crash mid-write
+# test/tool hook: called with the stage name ("written", "synced",
+# "replaced") around the temp-write/rename sequence; raising (or killing
+# the process) from it simulates a crash or an I/O fault at that point
 _FAULT_HOOK: Optional[Callable[[str, str], None]] = None
 
 
 def set_fault_hook(hook: Optional[Callable[[str, str], None]]) -> None:
-    """Install ``hook(stage, path)`` fired inside :func:`atomic_write` before
-    the rename (stages: "written" after the temp write, "synced" after fsync).
-    Pass ``None`` to clear.  Used by the fault-injection harness to prove a
-    mid-write kill never corrupts the destination file."""
+    """Install ``hook(stage, path)`` fired inside :func:`atomic_write`
+    (stages: "written" after the temp write, "synced" after fsync — both
+    before the rename — and "replaced" after ``os.replace`` but before the
+    directory fsync).  Pass ``None`` to clear.  Used by the fault-injection
+    harness to prove a mid-write kill never corrupts the destination file,
+    and — by raising ``OSError`` — to simulate transient (``EIO``) and
+    fatal (``ENOSPC``) filesystem faults against the retry policy."""
     global _FAULT_HOOK
     _FAULT_HOOK = hook
 
 
-def atomic_write(path: str, data, fsync: bool = True) -> None:
-    """Write ``data`` (str or bytes) to ``path`` atomically.
+# ---- retry-with-backoff for transient filesystem faults ----
+#
+# On shared/networked filesystems (the checkpoint store of a pod job) a
+# write can fail transiently: EIO on a flaky mount, EAGAIN/EINTR around a
+# remount, EBUSY on a contended rename.  Those are worth a bounded,
+# jittered retry.  EVERYTHING else is fatal for the write — ENOSPC/EDQUOT,
+# EROFS, permission errors, and unknown errnos alike: retrying disk-full
+# in a tight loop only delays the inevitable, and an unknown failure mode
+# should surface, not loop.  Callers with a skip policy (periodic
+# checkpoints are durability, not correctness) catch the raised OSError.
 
-    tmp file in the same directory -> write -> fsync -> rename(tmp, path).
-    ``os.replace`` is atomic on POSIX (and on Windows for same-volume paths),
-    so readers never observe a partial file and a crash leaves the previous
-    version intact.  Remote ``scheme://`` paths fall back to a plain
-    streamed write (their stores provide their own atomicity, if any).
+RETRYABLE_ERRNOS = frozenset(
+    e for e in (errno.EIO, errno.EAGAIN, errno.EINTR, errno.EBUSY,
+                getattr(errno, "ETIMEDOUT", None),
+                getattr(errno, "ESTALE", None)) if e is not None)
+
+_RETRY = {"attempts": 3, "base_delay": 0.05}
+_IO_RETRY_LOCK = threading.Lock()
+_IO_RETRIES = 0
+
+
+def configure_retries(attempts: Optional[int] = None,
+                      base_delay: Optional[float] = None) -> None:
+    """Set the process-wide file-I/O retry policy (``io_retry_attempts`` /
+    ``io_retry_backoff_s`` params route here via config)."""
+    if attempts is not None:
+        _RETRY["attempts"] = max(1, int(attempts))
+    if base_delay is not None:
+        _RETRY["base_delay"] = max(0.0, float(base_delay))
+
+
+def is_retryable(exc: OSError) -> bool:
+    """Transient-vs-fatal classification; unknown errnos count as fatal
+    (an unknown failure mode should surface, not loop)."""
+    return getattr(exc, "errno", None) in RETRYABLE_ERRNOS
+
+
+def io_retry_count() -> int:
+    """Total retried I/O attempts this process (always-on counter, the
+    ``obs.recompile`` discipline: readable without a telemetry run)."""
+    with _IO_RETRY_LOCK:
+        return _IO_RETRIES
+
+
+def reset_io_retry_count() -> None:
+    global _IO_RETRIES
+    with _IO_RETRY_LOCK:
+        _IO_RETRIES = 0
+
+
+def _note_retry(what: str, path: str, exc: OSError, attempt: int) -> None:
+    global _IO_RETRIES
+    with _IO_RETRY_LOCK:
+        _IO_RETRIES += 1
+    from .log import Log
+    Log.warning("%s %s failed transiently (%s); retrying (attempt %d/%d)",
+                what, path, exc, attempt + 1, _RETRY["attempts"])
+    from ..obs import active as _telemetry_active
+    tele = _telemetry_active()
+    if tele is not None:
+        tele.counter("io_retries").inc()
+        tele.event("io_retry", what=what, path=path,
+                   errno=int(getattr(exc, "errno", -1) or -1),
+                   attempt=int(attempt + 1))
+
+
+def retry_io(fn: Callable[[], object], what: str = "io", path: str = ""):
+    """Run ``fn`` with bounded, jittered exponential backoff on RETRYABLE
+    ``OSError``s; fatal errnos (disk full, permissions) raise immediately.
+    The generalized fault surface every durability write goes through."""
+    attempts = int(_RETRY["attempts"])
+    base = float(_RETRY["base_delay"])
+    for i in range(attempts):
+        try:
+            return fn()
+        except OSError as exc:
+            if not is_retryable(exc) or i == attempts - 1:
+                raise
+            _note_retry(what, path, exc, i)
+            # full jitter: uncorrelated sleep in [0.5, 1.5) * base * 2^i so
+            # d pod processes retrying the same shared store do not stampede
+            time.sleep(base * (1 << i) * (0.5 + random.random()))
+
+
+def _fsync_dir(dirname: str) -> None:
+    """fsync the directory so the rename itself is durable: POSIX only
+    guarantees the new directory entry survives a crash after the
+    CONTAINING directory is synced — without it the atomic_write can lose
+    the whole file (not just its tail) to a crash right after rename."""
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return  # e.g. O_RDONLY open of the dir refused; durability is
+        # best-effort beyond the data fsync
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass  # some filesystems reject fsync on directory fds (EINVAL)
+    finally:
+        os.close(dfd)
+
+
+def atomic_write(path: str, data, fsync: bool = True) -> None:
+    """Write ``data`` (str or bytes) to ``path`` atomically and durably.
+
+    tmp file in the same directory -> write -> fsync -> rename(tmp, path)
+    -> fsync(directory).  ``os.replace`` is atomic on POSIX (and on Windows
+    for same-volume paths), so readers never observe a partial file and a
+    crash leaves the previous version intact; the directory fsync makes the
+    rename itself crash-durable.  Transient filesystem faults (EIO, ...)
+    are retried with jittered backoff via :func:`retry_io`; fatal ones
+    (ENOSPC, permissions) raise.  Remote ``scheme://`` paths fall back to a
+    plain streamed write (their stores provide their own atomicity, if any).
     """
     if isinstance(data, str):
         data = data.encode("utf-8")
     if "://" in path:
-        with open_file(path, "wb") as fh:
-            fh.write(data)
+        retry_io(lambda: _scheme_write(path, data), "write", path)
         return
     d = os.path.dirname(os.path.abspath(path))
     tmp = os.path.join(d, ".%s.tmp.%d" % (os.path.basename(path), os.getpid()))
-    try:
-        with open(tmp, "wb") as fh:
-            fh.write(data)
-            if _FAULT_HOOK is not None:
-                _FAULT_HOOK("written", path)
-            if fsync:
-                fh.flush()
-                os.fsync(fh.fileno())
-        if _FAULT_HOOK is not None:
-            _FAULT_HOOK("synced", path)
-        os.replace(tmp, path)
-    except BaseException:
+
+    def attempt():
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+                if _FAULT_HOOK is not None:
+                    _FAULT_HOOK("written", path)
+                if fsync:
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            if _FAULT_HOOK is not None:
+                _FAULT_HOOK("synced", path)
+            os.replace(tmp, path)
+            if _FAULT_HOOK is not None:
+                _FAULT_HOOK("replaced", path)
+            if fsync:
+                _fsync_dir(d)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    retry_io(attempt, "atomic_write", path)
+
+
+def _scheme_write(path: str, data: bytes) -> None:
+    with open_file(path, "wb") as fh:
+        fh.write(data)
 
 
 _CRC_TRAILER = b"\nCRC32 "

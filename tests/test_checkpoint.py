@@ -251,6 +251,40 @@ def test_checkpoint_requires_matching_valid_sets(tmp_path):
         bare.resume_from_checkpoint(out)
 
 
+def test_checkpoint_rejects_different_dataset(tmp_path):
+    """Resume-vs-wrong-data guard: the dataset fingerprint (num_rows,
+    num_features, bin-mapper digest) rides the checkpoint header and a
+    restore against ANY other dataset hard-errors instead of silently
+    training the restored scores against rows they do not describe."""
+    params = dict(BASE)
+    out = str(tmp_path / "model.txt")
+    booster = build_booster(params, 10, snapshot_freq=5)
+    booster.train(snapshot_out=out)
+
+    def booster_on(X, y):
+        cfg = Config(dict(params, num_iterations=10, snapshot_freq=5))
+        ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
+                                       min_data_in_leaf=cfg.min_data_in_leaf)
+        b = create_boosting(cfg.boosting, cfg, ds,
+                            create_objective(cfg.objective, cfg))
+        b.add_train_metrics(create_metrics(cfg.metric, cfg))
+        Xv, yv = make_data(200, 7)
+        b.add_valid_data(BinnedDataset.from_matrix(Xv, label=yv,
+                                                   reference=ds), "valid_1")
+        return b
+
+    # same shape, different values -> different bin bounds -> digest differs
+    Xw, yw = make_data(seed=99)
+    with pytest.raises(CheckpointError, match="different dataset"):
+        booster_on(Xw, yw).resume_from_checkpoint(out)
+    # different row count
+    X, y = make_data()
+    with pytest.raises(CheckpointError, match="different dataset"):
+        booster_on(X[:-5], y[:-5]).resume_from_checkpoint(out)
+    # the matching dataset still resumes (newest checkpoint: iteration 10)
+    assert booster_on(X, y).resume_from_checkpoint(out) == 10
+
+
 def test_checkpoint_boosting_mode_mismatch(tmp_path):
     out = str(tmp_path / "model.txt")
     booster = build_booster(dict(BASE), 10, snapshot_freq=5)

@@ -193,6 +193,11 @@ def test_summary_artifact_contents(tmp_path):
     # MFU estimate fields present (ratios None off-accelerator, but the
     # analytic flop/byte gauges must be there)
     assert "mfu" in summary and "device_util" in summary
+    # resilience rollup (round 11): the fault counters ride every summary
+    res = summary["resilience"]
+    assert res["preemptions"] == 0 and res["io_retries"] == 0
+    assert res["predict_fallbacks"] == 0 and res["checkpoint_skipped"] == 0
+    assert res["preempt_checkpoint_s"]["count"] == 0
     assert summary["gauges"]["est_macs"] > 0
     assert summary["gauges"]["est_bytes"] > 0
     # the driver's train-loop gauges win over finalize_run's wall_s arg
@@ -330,9 +335,12 @@ def test_resumed_run_iterations_not_inflated(tmp_path):
 
 # ---- zero-overhead when off ----
 
-def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch):
+def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch, tmp_path):
     """With telemetry disabled (the default), a fused-scan training run and
-    a predict loop must record NOTHING: no events, no metric touches."""
+    a predict loop must record NOTHING: no events, no metric touches.
+    The resilience paths are held to the same contract: a degraded-predict
+    fallback and a retried I/O fault are counted in their always-on module
+    counters but make zero telemetry calls when no run is active."""
     calls = []
 
     def spy(name):
@@ -350,6 +358,31 @@ def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch):
     booster.train_chunk(8)
     booster.predict(X[:600])
     booster.train(None)  # the driver path too
+    # degraded predict: the fallback counter must not touch Telemetry
+    import lightgbm_tpu.core.predict_fused as pf
+    real_pb = pf.predict_blocked
+    monkeypatch.setattr(pf, "predict_blocked",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("injected")))
+    booster._invalidate_predict_cache()
+    booster.predict(X[:600])
+    monkeypatch.setattr(pf, "predict_blocked", real_pb)
+    # retried I/O fault: io_retry accounting stays off-Telemetry too
+    import errno
+
+    from lightgbm_tpu.utils import file_io
+    state = {"n": 0}
+
+    def eio_once(stage, path):
+        if stage == "written" and state["n"] == 0:
+            state["n"] += 1
+            raise OSError(errno.EIO, "injected")
+
+    file_io.set_fault_hook(eio_once)
+    try:
+        file_io.atomic_write(str(tmp_path / "t.txt"), "x")
+    finally:
+        file_io.set_fault_hook(None)
     assert calls == [], "telemetry-off run made %d telemetry calls: %r" % (
         len(calls), calls[:5])
 

@@ -157,6 +157,9 @@ int LGBM_TelemetryDisable();
 int LGBM_TelemetrySummary(int64_t buffer_len, int64_t* out_len,
     char* out_str);
 int LGBM_TelemetryRecompileCount(int64_t* out_count);
+int LGBM_PreemptionInstall();
+int LGBM_PreemptionRequested(int64_t* out_flag);
+int LGBM_PredictFallbackCount(int64_t* out_count);
 int LGBM_NetworkInit(const char* machines, int local_listen_port,
     int listen_time_out, int num_machines);
 int LGBM_NetworkFree();

@@ -1,5 +1,5 @@
 """Fault-injection harness: prove every recovery path of the fault-tolerant
-training runtime (lightgbm_tpu/checkpoint.py) actually recovers.
+training runtime (lightgbm_tpu/checkpoint.py + resilience.py) recovers.
 
 Scenarios (each prints PASS/FAIL and exits nonzero on failure):
 
@@ -15,7 +15,28 @@ Scenarios (each prints PASS/FAIL and exits nonzero on failure):
   nan-grad     Train with gradients that go non-finite at a chosen iteration
                under each nan_policy: raise must raise a LightGBMError,
                skip_iter / clip must complete with a finite model.
+  sigterm      Preempt a trainer with SIGTERM mid-run (the dominant TPU-fleet
+               fault).  The installed handler sets a flag; the loop polls it
+               at the next CHUNK boundary, writes an emergency checkpoint,
+               and exits with resilience.EXIT_PREEMPTED (75) so a supervisor
+               knows "resumable".  Asserts the distinct exit code, the
+               checkpoint, and a bit-exact resume vs an uninterrupted run.
+  hang         Stall the fused-chunk dispatch forever (a dead-peer collective
+               stand-in).  The armed watchdog must dump a diagnostic
+               artifact (section, device set, recompile/timer state) and
+               abort with resilience.EXIT_STALLED (79) within 2x
+               watchdog_timeout_s instead of hanging until the scheduler
+               reaps the job.
+  enospc       Periodic checkpoint/snapshot writes hit injected filesystem
+               faults: transient EIO is retried (bounded jittered backoff in
+               utils/file_io.py) and the checkpoint lands; persistent ENOSPC
+               skips THAT checkpoint and training completes anyway (periodic
+               durability is best-effort, never fatal to a healthy run).
   all          Run every scenario.
+
+``--matrix`` runs every scenario, prints a pass/fail table, and writes a
+JSON report (``--report``, default <workdir>/fault_matrix.json) — the
+one-command preemption drill PERF.md's multi-host protocol builds on.
 
 Small CPU shapes; run with JAX_PLATFORMS=cpu anywhere.  The byte-level
 helpers (corrupt_file / truncate_file) are imported by
@@ -23,9 +44,11 @@ tests/test_checkpoint.py so the pytest suite and this CLI exercise the same
 fault model.
 """
 import argparse
+import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -219,25 +242,242 @@ def scenario_nan_grad(workdir: str) -> None:
         print("PASS nan-grad[%s]: %s" % (policy, want))
 
 
+# ---- sigterm: preemption -> emergency checkpoint -> distinct exit code ----
+
+_SIGTERM_CHILD_SRC = _TRAIN_SRC + r"""
+# preempted like a real TPU worker: SIGTERM lands after the Nth chunk (the
+# handler only sets a flag; the loop polls it at the next chunk boundary)
+import signal
+from lightgbm_tpu import resilience
+
+resilience.install_preemption_handler()
+booster = build(int(os.environ["TOTAL_ITERS"]), int(os.environ["SNAP_FREQ"]))
+orig_chunk = booster.train_chunk
+state = {"n": 0}
+sig_after = int(os.environ["SIG_AFTER_CHUNKS"])
+
+def chunk(k):
+    r = orig_chunk(k)
+    state["n"] += 1
+    if state["n"] == sig_after:
+        signal.raise_signal(signal.SIGTERM)
+    return r
+
+booster.train_chunk = chunk
+try:
+    booster.train(snapshot_out=os.environ["MODEL_OUT"])
+except resilience.TrainingPreempted as exc:
+    print("PREEMPTED iter=%d ckpt=%s" % (exc.iteration, exc.checkpoint_path))
+    sys.exit(resilience.EXIT_PREEMPTED)
+booster.save_model(os.environ["MODEL_OUT"])
+print("TRAINED-TO-END")
+"""
+
+
+def scenario_sigterm(workdir: str) -> None:
+    """SIGTERM mid-train -> emergency checkpoint -> bit-exact resume."""
+    from lightgbm_tpu.checkpoint import list_checkpoints
+    from lightgbm_tpu.resilience import EXIT_PREEMPTED
+    total, sf = 20, 7
+    ref = _uninterrupted_model(workdir, total, sf)
+    out = os.path.join(workdir, "model_sig.txt")
+    p = _run_child(_SIGTERM_CHILD_SRC, {
+        "MODEL_OUT": out, "TOTAL_ITERS": str(total), "SNAP_FREQ": str(sf),
+        "SIG_AFTER_CHUNKS": "2"})
+    assert p.returncode == EXIT_PREEMPTED, \
+        "expected exit %d (resumable), got %r: %s" % (
+            EXIT_PREEMPTED, p.returncode, p.stdout + p.stderr[-2000:])
+    assert "PREEMPTED" in p.stdout and "TRAINED-TO-END" not in p.stdout
+    ckpts = list_checkpoints(out)
+    assert ckpts, "no emergency checkpoint on disk"
+    sys.path.insert(0, REPO)
+    ns = {}
+    exec(compile(_TRAIN_SRC, "<train>", "exec"), ns)
+    booster = ns["build"](total, sf)
+    resumed = booster.resume_from_checkpoint(out)
+    assert 0 < resumed < total, resumed
+    booster.train()
+    assert booster.save_model_to_string() == ref, \
+        "SIGTERM-preempted resume diverged from the uninterrupted run"
+    print("PASS sigterm: exit code %d + emergency checkpoint at iter %d; "
+          "resume is bit-exact" % (EXIT_PREEMPTED, resumed))
+
+
+# ---- hang: stalled dispatch -> watchdog abort + diagnostic artifact ----
+
+_HANG_CHILD_SRC = _TRAIN_SRC + r"""
+# a dead-peer collective stand-in: the cached fused-chunk program is
+# replaced with a sleeper AFTER one healthy chunk ran under the armed
+# watchdog (completing a section = the compiled program is proven cached,
+# so the hung dispatch is held to the PLAIN timeout, not the
+# first-dispatch compile grace), so the next dispatch blocks forever
+# inside the watch section
+import time
+from lightgbm_tpu import resilience
+
+booster = build(12, -1)
+resilience.start_watchdog(float(os.environ["WD_TIMEOUT"]),
+                          artifact=os.environ["STALL_ARTIFACT"])
+booster.train_chunk(4)  # healthy: compiles + caches + completes a section
+for key in list(booster._fused_cache):
+    booster._fused_cache[key] = lambda *a, **k: time.sleep(3600)
+print("WATCHDOG-ARMED %f" % time.time(), flush=True)
+booster.train()  # hangs; the watchdog aborts with EXIT_STALLED
+print("UNREACHABLE")
+"""
+
+
+def scenario_hang(workdir: str) -> None:
+    """Stalled dispatch -> watchdog abort within 2x timeout + artifact."""
+    from lightgbm_tpu.resilience import EXIT_STALLED
+    art = os.path.join(workdir, "stall.json")
+    timeout_s = 2.0
+    p = _run_child(_HANG_CHILD_SRC, {"WD_TIMEOUT": str(timeout_s),
+                                     "STALL_ARTIFACT": art})
+    assert p.returncode == EXIT_STALLED, \
+        "expected exit %d (stalled), got %r: %s" % (
+            EXIT_STALLED, p.returncode, p.stdout + p.stderr[-2000:])
+    assert "UNREACHABLE" not in p.stdout
+    armed = float(p.stdout.split("WATCHDOG-ARMED", 1)[1].split()[0])
+    with open(art) as fh:
+        diag = json.load(fh)
+    assert diag["section"] == "fused_train_chunk", diag
+    assert diag["stall_s"] >= timeout_s, diag
+    detect = diag["ts"] - armed
+    assert detect < 2 * timeout_s, \
+        "watchdog took %.1f s to abort (bar: < %.1f s)" % (detect,
+                                                           2 * timeout_s)
+    assert "devices" in diag and "recompiles" in diag, diag
+    print("PASS hang: watchdog aborted the stalled dispatch in %.1f s "
+          "(< 2x timeout %.1f s) with diagnostics at %s"
+          % (detect, timeout_s, art))
+
+
+# ---- enospc: disk-full checkpoints skipped, transient EIO retried ----
+
+_ENOSPC_CHILD_SRC = _TRAIN_SRC + r"""
+# filesystem faults scoped to the PERIODIC durability writes (checkpoint +
+# model snapshot): "enospc" injects persistent disk-full, "eio-once" one
+# transient error per path (must be absorbed by the retry policy)
+import errno
+from lightgbm_tpu.utils import file_io
+
+mode = os.environ["IO_FAULT"]
+seen = set()
+
+def fault(stage, path):
+    if stage != "written":
+        return
+    if ".ckpt_iter_" not in path and ".snapshot_iter_" not in path:
+        return
+    if mode == "enospc":
+        raise OSError(errno.ENOSPC, "No space left on device (injected)")
+    if path not in seen:
+        seen.add(path)
+        raise OSError(errno.EIO, "Input/output error (injected)")
+
+file_io.set_fault_hook(fault)
+booster = build(int(os.environ["TOTAL_ITERS"]), int(os.environ["SNAP_FREQ"]))
+booster.train(snapshot_out=os.environ["MODEL_OUT"])
+file_io.set_fault_hook(None)
+booster.save_model(os.environ["MODEL_OUT"])
+from lightgbm_tpu.checkpoint import list_checkpoints
+print("COMPLETED trees=%d ckpts=%d retries=%d"
+      % (booster.num_trees, len(list_checkpoints(os.environ["MODEL_OUT"])),
+         file_io.io_retry_count()))
+"""
+
+
+def scenario_enospc(workdir: str) -> None:
+    """Checkpoint writes hit disk-full / flaky-mount faults; training
+    continues (skip vs retry per the errno classification)."""
+    total, sf = 20, 7
+    # persistent ENOSPC: every periodic checkpoint/snapshot is skipped with
+    # a warning; the run itself completes and the final model lands
+    out = os.path.join(workdir, "model_ns.txt")
+    p = _run_child(_ENOSPC_CHILD_SRC, {
+        "MODEL_OUT": out, "TOTAL_ITERS": str(total), "SNAP_FREQ": str(sf),
+        "IO_FAULT": "enospc"})
+    assert "COMPLETED trees=%d ckpts=0" % total in p.stdout, \
+        p.stdout + p.stderr[-2000:]
+    assert os.path.exists(out), "final model missing"
+    print("PASS enospc[skip]: disk-full checkpoints skipped, training "
+          "completed, final model written")
+    # transient EIO: the bounded jittered retry absorbs one failure per
+    # path — all checkpoints land and the retry counter shows the work
+    out2 = os.path.join(workdir, "model_eio.txt")
+    p = _run_child(_ENOSPC_CHILD_SRC, {
+        "MODEL_OUT": out2, "TOTAL_ITERS": str(total), "SNAP_FREQ": str(sf),
+        "IO_FAULT": "eio-once"})
+    assert "COMPLETED trees=%d ckpts=2" % total in p.stdout, \
+        p.stdout + p.stderr[-2000:]
+    assert "retries=0" not in p.stdout.split("COMPLETED", 1)[1]
+    print("PASS enospc[retry]: transient EIO absorbed by retry; all "
+          "checkpoints landed")
+
+
+SCENARIOS = {"kill-write": scenario_kill_write,
+             "corrupt": scenario_corrupt,
+             "nan-grad": scenario_nan_grad,
+             "sigterm": scenario_sigterm,
+             "hang": scenario_hang,
+             "enospc": scenario_enospc}
+
+
+def run_matrix(workdir: str, report_path: str) -> int:
+    """Run every scenario, print a pass/fail table, write the JSON report.
+    Returns the number of failures (process exit code)."""
+    report = {}
+    for name, fn in SCENARIOS.items():
+        t0 = time.time()
+        try:
+            fn(workdir)
+            report[name] = {"status": "pass",
+                            "seconds": round(time.time() - t0, 2)}
+        except BaseException as exc:  # noqa: BLE001 - report, don't die
+            report[name] = {"status": "fail",
+                            "seconds": round(time.time() - t0, 2),
+                            "detail": "%s: %s" % (type(exc).__name__, exc)}
+    from lightgbm_tpu.utils.file_io import atomic_write
+    atomic_write(report_path, json.dumps(report, indent=1))
+    print("\nfault matrix (%s):" % report_path)
+    for name, r in report.items():
+        print("  %-12s %-4s %6.1fs  %s" % (name, r["status"].upper(),
+                                           r["seconds"],
+                                           r.get("detail", "")))
+    failures = sum(1 for r in report.values() if r["status"] != "pass")
+    print("MATRIX %s (%d/%d passed)"
+          % ("PASSED" if failures == 0 else "FAILED",
+             len(report) - failures, len(report)))
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="fault-injection harness for the checkpoint/resume "
-                    "runtime (kill mid-write, corrupt/truncate, NaN "
-                    "gradients)")
+        description="fault-injection harness for the checkpoint/resume + "
+                    "resilience runtime (kill mid-write, corrupt/truncate, "
+                    "NaN gradients, SIGTERM preemption, stalled-dispatch "
+                    "watchdog, disk-full checkpoint writes)")
     ap.add_argument("scenario", nargs="?", default="all",
-                    choices=["all", "kill-write", "corrupt", "nan-grad"])
+                    choices=["all"] + sorted(SCENARIOS))
+    ap.add_argument("--matrix", action="store_true",
+                    help="run every scenario and emit a JSON pass/fail "
+                         "report instead of stopping at the first failure")
+    ap.add_argument("--report", default=None,
+                    help="matrix report path (default: "
+                         "<workdir>/fault_matrix.json)")
     ap.add_argument("--workdir", default=None,
                     help="scratch directory (default: a fresh tempdir)")
     args = ap.parse_args(argv)
     import tempfile
     workdir = args.workdir or tempfile.mkdtemp(prefix="lgbm_fault_")
     sys.path.insert(0, REPO)
-    scenarios = {"kill-write": scenario_kill_write,
-                 "corrupt": scenario_corrupt,
-                 "nan-grad": scenario_nan_grad}
-    names = list(scenarios) if args.scenario == "all" else [args.scenario]
+    if args.matrix:
+        report = args.report or os.path.join(workdir, "fault_matrix.json")
+        return 1 if run_matrix(workdir, report) else 0
+    names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
     for name in names:
-        scenarios[name](workdir)
+        SCENARIOS[name](workdir)
     print("ALL FAULT SCENARIOS PASSED")
     return 0
 

@@ -75,17 +75,32 @@ def summary_from_events(events):
     hists = {}
     counters = {}
     recompiles = {}
+    # resilience event kind -> summary-counter name (the faults a died run
+    # absorbed are exactly what its post-mortem reader wants first)
+    res_kinds = {"preempt_checkpoint": "preemptions",
+                 "io_retry": "io_retries",
+                 "predict_fallback": "predict_fallbacks",
+                 "checkpoint_skipped": "checkpoint_skipped",
+                 "watchdog_stall": "watchdog_stalls",
+                 "elastic_resume": "elastic_resumes"}
+    resilience = {}
     for e in events:
         counters[e["kind"]] = counters.get(e["kind"], 0) + 1
         dt = e.get("dt_s")
         if isinstance(dt, (int, float)):
             hists.setdefault(e["kind"] + "_s", Histogram()).observe(dt)
+        if e["kind"] in res_kinds:
+            key = res_kinds[e["kind"]]
+            resilience[key] = resilience.get(key, 0) + 1
+            if e["kind"] == "watchdog_stall":
+                resilience["watchdog_stall_s"] = e.get("stall_s")
         if e["kind"] == "recompile":
             # one event can carry n>1 compiles (a cache that grew by
             # several programs in one dispatch)
             key = "%s|%s" % (e.get("fn", "?"), e.get("bucket", "?"))
             recompiles[key] = recompiles.get(key, 0) + int(e.get("n", 1))
     return {
+        "resilience": resilience,
         "metric": "telemetry_run", "unit": "row-trees/s", "value": None,
         "iterations": None, "wall_s": None,
         "recompiles": recompiles,
