@@ -37,6 +37,7 @@ from ..metric.metric import Metric, create_metrics
 from ..objective import ObjectiveFunction, create_objective
 from ..obs import active as _telemetry_active
 from ..obs import annotate as _annotate
+from ..obs import launches as _launches
 from ..obs import recompile as _recompile
 from ..resilience import preemption_requested as _preemption_requested
 from ..resilience import watch as _watch
@@ -131,6 +132,40 @@ def _add_valid_outputs(vscores, kk, arr, feat, vbins, num_leaves,
         vsc.at[kk].add(tree_output_binned(
             vb, arr, feat, num_leaves=num_leaves, depth_bound=depth))
         for vsc, vb in zip(vscores, vbins))
+
+
+def _scan_grouped(step, carry, its, group: int):
+    """``jax.lax.scan`` of ``step`` over ``its`` with ``group`` consecutive
+    steps unrolled per scan iteration (round 12 ``trees_per_chunk``): the
+    scan body then amortizes its per-step dispatch/bookkeeping cost over
+    ``group`` tree builds — the small-tree regime where scan-step overhead
+    rivals the build itself.  The SAME ``step`` calls run in the SAME order
+    with the same carries as ``group=1`` (only the scan structure changes),
+    so results are bit-exact vs the ungrouped scan (pinned by
+    tests/test_partition_buckets.py).  A non-dividing tail runs as a second
+    ungrouped scan; stacked outputs are re-flattened to per-step order."""
+    k = int(its.shape[0])
+    if group <= 1 or k <= 1:
+        return jax.lax.scan(step, carry, its)
+    g = min(int(group), k)
+    main = (k // g) * g
+
+    def gstep(c, it_vec):
+        outs = []
+        for j in range(g):
+            c, out = step(c, it_vec[j])
+            outs.append(out)
+        return c, jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *outs)
+
+    carry, stacked = jax.lax.scan(gstep, carry,
+                                  its[:main].reshape(k // g, g))
+    stacked = jax.tree_util.tree_map(
+        lambda x: x.reshape((main,) + x.shape[2:]), stacked)
+    if main < k:
+        carry, tail = jax.lax.scan(step, carry, its[main:])
+        stacked = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), stacked, tail)
+    return carry, stacked
 
 
 class _LazyTreeSlice:
@@ -744,6 +779,12 @@ class GBDT:
             return float(cfg.bagging_fraction), int(cfg.bagging_freq)
         return None
 
+    def _trees_per_chunk(self) -> int:
+        """Round-12 ``trees_per_chunk``: consecutive boosting iterations
+        grouped into one fused-scan step so several small trees share a scan
+        step's dispatch cost.  Bit-exact vs 1 (same step sequence)."""
+        return max(1, int(getattr(self.config, "trees_per_chunk", 1) or 1))
+
     def _can_carry_rows(self) -> bool:
         """Carried-row-store training: per-row boosting state (aux, score)
         rides the tree builder's permutation so no per-row gather/scatter
@@ -786,6 +827,7 @@ class GBDT:
                       # the branch at run time
                       bucket_plan=learner.bucket_plan,
                       pallas_interpret=learner.pallas_interpret,
+                      tree_grow_mode=learner.effective_grow_mode(),
                       carried=True)
 
         def f32col(rows, off):
@@ -848,9 +890,9 @@ class GBDT:
                 bins, zero, zero, nd, fm, feat,
                 extra=(aux_arg, score[0, :ntot]),
                 score_rate=jnp.float32(rate), **init_kwargs)
-            (rows_fin, vs_out), stacked = jax.lax.scan(
+            (rows_fin, vs_out), stacked = _scan_grouped(
                 one_iter_of(bins), (rows0, tuple(vscores)),
-                it0 + jnp.arange(k, dtype=jnp.int32))
+                it0 + jnp.arange(k, dtype=jnp.int32), self._trees_per_chunk())
             sc = f32col(rows_fin, soff)
             order = jax.lax.bitcast_convert_type(
                 rows_fin[:, voff + 8:voff + 12], jnp.int32
@@ -886,7 +928,8 @@ class GBDT:
                       packed_cols=learner.packed_cols,
                       hist_pool_slots=learner.hist_pool_slots,
                       bucket_plan=learner.bucket_plan,
-                      pallas_interpret=learner.pallas_interpret)
+                      pallas_interpret=learner.pallas_interpret,
+                      tree_grow_mode=learner.effective_grow_mode())
 
         bag = self._fused_bag()
         bag_seed = int(self.config.bagging_seed)
@@ -927,9 +970,9 @@ class GBDT:
             return one_iter
 
         def fused(score, vscores, it0):
-            (score, vs_out), stacked = jax.lax.scan(
+            (score, vs_out), stacked = _scan_grouped(
                 one_iter_of(learner.bins), (score, tuple(vscores)),
-                it0 + jnp.arange(k, dtype=jnp.int32))
+                it0 + jnp.arange(k, dtype=jnp.int32), self._trees_per_chunk())
             return score, vs_out, stacked
 
         return _hoisted_jit(fused, self.train_score,
@@ -997,6 +1040,12 @@ class GBDT:
         for vs, vsc in zip(self.valid_sets, new_vscores):
             vs["score"] = vsc
         K = self.num_tree_per_iteration
+        # the fused scan ran one tree build per in-scan iteration — account
+        # its (trace-static) split-launch structure like the per-iteration
+        # path does in SerialTreeLearner.train
+        _launches.record(self.learner.effective_grow_mode(),
+                         self.learner.launches_per_tree(),
+                         trees=num_iters * K)
         first_idx = len(self._models)
         first_iter = self.iter_
         self._last_iter_arrays = []

@@ -258,6 +258,11 @@ class Config:
         if self.nan_policy not in ("raise", "skip_iter", "clip"):
             Log.fatal("Unknown nan_policy %s (expected raise, skip_iter or "
                       "clip)", self.nan_policy)
+        # round-12 dispatch params
+        self.tree_grow_mode = str(self.tree_grow_mode).lower()
+        if self.tree_grow_mode not in ("leaf", "level"):
+            Log.fatal("Unknown tree_grow_mode %s (expected leaf or level)",
+                      self.tree_grow_mode)
         if ("io_retry_attempts" in self.raw_params
                 or "io_retry_backoff_s" in self.raw_params):
             # the retry policy guards a process-global primitive

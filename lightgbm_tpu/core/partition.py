@@ -138,6 +138,25 @@ def fused_bucket_plan(n: int) -> tuple:
     return tuple(plan)
 
 
+class _ScalRow:
+    """One window's scalar-prefetch row: ``scal[i]`` reads ``scal_ref[i]``
+    for the single-window kernels and ``scal_ref[g, i]`` for a grid step of
+    the multi-window (level-batched) variants — the kernel bodies and their
+    shared building blocks (:func:`_route_tile`, :func:`_hist_tile`) index
+    the view identically in both modes, which is what keeps the
+    level-batched launch bit-exact against a sequence of single-window
+    launches (same op sequence per window)."""
+
+    def __init__(self, ref, g=None):
+        self._ref = ref
+        self._g = g
+
+    def __getitem__(self, i):
+        if self._g is None:
+            return self._ref[i]
+        return self._ref[self._g, i]
+
+
 def _route_tile(col, scal_ref, num_bins):
     """go-left decision as a [T, 1] i32 0/1 vector (Mosaic cannot truncate i8
     vectors to i1, so boolean logic stays in i32 arithmetic); scalar split
@@ -286,7 +305,7 @@ def _hist_tile(ti_c, hist_ref, scal_ref, start, cnt, *, num_features,
 
 def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                            packed, exact, f_shard=False, dbg_skip="",
-                           chunk=CHUNK):
+                           chunk=CHUNK, multiwin=False):
     # f_shard: the histogrammed feature window starts at scal[12 + B//32]
     # (feature-parallel shards build only their own F/d block while routing
     # on the full row store); num_features is then the WINDOW's width
@@ -309,10 +328,12 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
         # never stalls on HBM writes (sync flushes were ~60% of the kernel
         # in round-4 profiles).
         del rows_in_ref
-        wb = scal_ref[0]
-        wc = scal_ref[1]
-        gcol = scal_ref[2]
-        hist_left = scal_ref[9]
+        scal = (_ScalRow(scal_ref, pl.program_id(0)) if multiwin
+                else scal_ref)
+        wb = scal[0]
+        wc = scal[1]
+        gcol = scal[2]
+        hist_left = scal[9]
 
         wb_al = pl.multiple_of((wb // _ALIGN) * _ALIGN, _ALIGN)
         headL = wb - wb_al
@@ -425,7 +446,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
             else:
                 col_p = _extract_col_lanes(ti_i8, gcol, W=W, bpc=bpc,
                                            packed=packed, npk=npk)
-            gl_p = _route_tile(col_p, scal_ref, num_bins)    # [npk, 128]
+            gl_p = _route_tile(col_p, scal, num_bins)        # [npk, 128]
             pos_p = (abs0
                      + jax.lax.broadcasted_iota(jnp.int32, (npk, 1), 0)
                      * _LANE
@@ -705,7 +726,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                             inbuf.at[nxt], sem_in.at[nxt]).start()
 
                     ti_c = inbuf[slot].astype(jnp.int32)
-                    _hist_tile(ti_c, hist_ref, scal_ref,
+                    _hist_tile(ti_c, hist_ref, scal,
                                head - c * chunk, cnt,
                                num_features=num_features, num_bins=num_bins,
                                bpc=bpc, packed=packed, exact=exact,
@@ -836,7 +857,7 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
 def _make_small_partition_kernel(*, n_pad, W, num_features, num_bins, voff,
                                  bpc, packed, exact, f_shard=False,
-                                 dbg_skip="", sc=SMALL_CHUNK):
+                                 dbg_skip="", sc=SMALL_CHUNK, multiwin=False):
     """Round-7 small-window variant: the whole window fits ONE ``sc``-row
     chunk (dispatch bound: wc <= sc - _ALIGN), so the entire streaming
     apparatus disappears — no input ring, no flush rings, no deferred phase
@@ -860,96 +881,112 @@ def _make_small_partition_kernel(*, n_pad, W, num_features, num_bins, voff,
     def kernel(scal_ref, rows_in_ref, rows_ref, hist_ref, nl_ref,
                inbuf, outbuf, ltri, sem):
         del rows_in_ref
-        wb = scal_ref[0]
-        wc = scal_ref[1]
-        gcol = scal_ref[2]
-        hist_left = scal_ref[9]
+        scal = (_ScalRow(scal_ref, pl.program_id(0)) if multiwin
+                else scal_ref)
+        wb = scal[0]
+        wc = scal[1]
+        gcol = scal[2]
+        hist_left = scal[9]
         wb_al = pl.multiple_of((wb // _ALIGN) * _ALIGN, _ALIGN)
         headL = wb - wb_al
 
         hist_ref[...] = jnp.zeros_like(hist_ref)
-        ltri[...] = (jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
-                     <= jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
-                     ).astype(jnp.int8)
+        nl_ref[...] = jnp.zeros_like(nl_ref)
 
-        # one read covers the whole window (+ head slack); rows past the
-        # window are carried through the identity permutation and written
-        # back byte-identical, so the RMW is safe for the neighbour leaf
-        cp = pltpu.make_async_copy(rows_ref.at[pl.ds(wb_al, sc)],
-                                   inbuf, sem)
-        cp.start()
-        cp.wait()
-        ti_i8 = jax.lax.bitcast_convert_type(inbuf[...], jnp.int8)
+        # empty windows (dead leaf-wise iterations, level-batched slots
+        # whose window belongs to another bucket class) skip the read,
+        # permutation and write-back entirely: the partition of an empty
+        # window is the identity and its histogram is the zeros above, so
+        # skipping is bit-exact AND makes the per-slot cost of a
+        # class-mismatched window just the grid-step bookkeeping — which is
+        # what lets a level launch carry every frontier slot in every class
+        @pl.when(wc > 0)
+        def _run_window():
+            ltri[...] = (jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+                         <= jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+                         ).astype(jnp.int8)
 
-        # ---- phase A: shared extract/route/prefix, all lane-resident ----
-        col_p = _extract_col_lanes(ti_i8, gcol, W=W, bpc=bpc, packed=packed,
-                                   npk=npk)
-        gl_p = _route_tile(col_p, scal_ref, num_bins)        # [npk, 128]
-        pos_p = (wb_al
-                 + jax.lax.broadcasted_iota(jnp.int32, (npk, 1), 0) * _LANE
-                 + jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1))
-        inw_p = ((pos_p >= wb).astype(jnp.int32)
-                 * (pos_p < wb + wc).astype(jnp.int32))
-        selL_p = gl_p * inw_p
-        selR_p = (1 - gl_p) * inw_p
-        if T == _LANE:
-            S_L, S_R = selL_p, selR_p
-        else:
-            S_L = selL_p.reshape(nsub, T)
-            S_R = selR_p.reshape(nsub, T)
-        pfxU, _tot, incl_col, excl_col = _subtile_prefixes(S_L, S_R, ltri,
-                                                          nsub=nsub)
-        nlv = incl_col[nsub - 1:nsub, 0:1].astype(jnp.int32)     # [1, 1]
+            # one read covers the whole window (+ head slack); rows past the
+            # window are carried through the identity permutation and written
+            # back byte-identical, so the RMW is safe for the neighbour leaf
+            cp = pltpu.make_async_copy(rows_ref.at[pl.ds(wb_al, sc)],
+                                       inbuf, sem)
+            cp.start()
+            cp.wait()
+            ti_i8 = jax.lax.bitcast_convert_type(inbuf[...], jnp.int8)
 
-        # ---- placement: window-global destinations, no staging ring ----
-        # dest is a permutation of [0, sc): left rows compact to
-        # [headL, headL + nl), right rows to [headL + nl, headL + wc),
-        # out-of-window rows keep their own position — one [sc, T] one-hot
-        # dot per subtile accumulates the permuted tile (each output row
-        # receives exactly one contribution)
-        iota_sc = jax.lax.broadcasted_iota(jnp.int32, (sc, 1), 0)
-        iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
-        comp_i = jnp.zeros((sc, W), jnp.int32)
-        for s in range(nsub):
-            selLs = S_L[s:s + 1, :]
-            selRs = S_R[s:s + 1, :]
-            pfxLs = pfxU[s:s + 1, :]
-            pfxRs = pfxU[nsub + s:nsub + s + 1, :]
-            bL = excl_col[s:s + 1, 0:1].astype(jnp.int32)
-            bR = excl_col[nsub + s:nsub + s + 1, 0:1].astype(jnp.int32)
-            destL = headL + bL + pfxLs - 1
-            destR = headL + nlv + bR + pfxRs - 1
-            own = s * T + iota_lane
-            dest = jnp.where(selLs == 1, destL,
-                             jnp.where(selRs == 1, destR, own))
-            Pt = (dest == iota_sc).astype(jnp.int8)              # [sc, T]
-            comp_i = comp_i + jax.lax.dot_general(
-                Pt, ti_i8[s * T:(s + 1) * T, :],
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32)                # [sc, W]
-        outbuf[...] = (comp_i & 255).astype(jnp.uint8)
+            # ---- phase A: shared extract/route/prefix, lane-resident ----
+            col_p = _extract_col_lanes(ti_i8, gcol, W=W, bpc=bpc,
+                                       packed=packed, npk=npk)
+            gl_p = _route_tile(col_p, scal, num_bins)        # [npk, 128]
+            pos_p = (wb_al
+                     + jax.lax.broadcasted_iota(jnp.int32, (npk, 1), 0)
+                     * _LANE
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, _LANE), 1))
+            inw_p = ((pos_p >= wb).astype(jnp.int32)
+                     * (pos_p < wb + wc).astype(jnp.int32))
+            selL_p = gl_p * inw_p
+            selR_p = (1 - gl_p) * inw_p
+            if T == _LANE:
+                S_L, S_R = selL_p, selR_p
+            else:
+                S_L = selL_p.reshape(nsub, T)
+                S_R = selR_p.reshape(nsub, T)
+            pfxU, _tot, incl_col, excl_col = _subtile_prefixes(S_L, S_R,
+                                                               ltri,
+                                                               nsub=nsub)
+            nlv = incl_col[nsub - 1:nsub, 0:1].astype(jnp.int32)  # [1, 1]
 
-        # left count out via a plain VMEM [1, 1] write — no SMEM totals DMA
-        # and no vector->scalar extraction anywhere in this variant
-        nl_ref[...] = nlv
+            # ---- placement: window-global destinations, no staging ring --
+            # dest is a permutation of [0, sc): left rows compact to
+            # [headL, headL + nl), right rows to [headL + nl, headL + wc),
+            # out-of-window rows keep their own position — one [sc, T]
+            # one-hot dot per subtile accumulates the permuted tile (each
+            # output row receives exactly one contribution)
+            iota_sc = jax.lax.broadcasted_iota(jnp.int32, (sc, 1), 0)
+            iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+            comp_i = jnp.zeros((sc, W), jnp.int32)
+            for s in range(nsub):
+                selLs = S_L[s:s + 1, :]
+                selRs = S_R[s:s + 1, :]
+                pfxLs = pfxU[s:s + 1, :]
+                pfxRs = pfxU[nsub + s:nsub + s + 1, :]
+                bL = excl_col[s:s + 1, 0:1].astype(jnp.int32)
+                bR = excl_col[nsub + s:nsub + s + 1, 0:1].astype(jnp.int32)
+                destL = headL + bL + pfxLs - 1
+                destR = headL + nlv + bR + pfxRs - 1
+                own = s * T + iota_lane
+                dest = jnp.where(selLs == 1, destL,
+                                 jnp.where(selRs == 1, destR, own))
+                Pt = (dest == iota_sc).astype(jnp.int8)          # [sc, T]
+                comp_i = comp_i + jax.lax.dot_general(
+                    Pt, ti_i8[s * T:(s + 1) * T, :],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)            # [sc, W]
+            outbuf[...] = (comp_i & 255).astype(jnp.uint8)
 
-        # ---- smaller child's histogram from the SAME resident tile ----
-        if "hist" not in dbg_skip:
-            ti_c = outbuf[...].astype(jnp.int32)
-            start = jnp.where(hist_left == 1,
-                              jnp.full((1, 1), 1, jnp.int32) * headL,
-                              headL + nlv)
-            cnt = jnp.where(hist_left == 1, nlv, wc - nlv)
-            _hist_tile(ti_c, hist_ref, scal_ref, start, cnt,
-                       num_features=num_features, num_bins=num_bins,
-                       bpc=bpc, packed=packed, exact=exact, voff=voff,
-                       f_shard=f_shard)
+            # left count out via a plain VMEM [1, 1] write — no SMEM totals
+            # DMA and no vector->scalar extraction anywhere in this variant
+            nl_ref[...] = nlv
 
-        # ---- single write-back DMA ----
-        cpo = pltpu.make_async_copy(outbuf, rows_ref.at[pl.ds(wb_al, sc)],
-                                    sem)
-        cpo.start()
-        cpo.wait()
+            # ---- smaller child's histogram from the SAME resident tile --
+            if "hist" not in dbg_skip:
+                ti_c = outbuf[...].astype(jnp.int32)
+                start = jnp.where(hist_left == 1,
+                                  jnp.full((1, 1), 1, jnp.int32) * headL,
+                                  headL + nlv)
+                cnt = jnp.where(hist_left == 1, nlv, wc - nlv)
+                _hist_tile(ti_c, hist_ref, scal, start, cnt,
+                           num_features=num_features, num_bins=num_bins,
+                           bpc=bpc, packed=packed, exact=exact, voff=voff,
+                           f_shard=f_shard)
+
+            # ---- single write-back DMA ----
+            cpo = pltpu.make_async_copy(outbuf,
+                                        rows_ref.at[pl.ds(wb_al, sc)],
+                                        sem)
+            cpo.start()
+            cpo.wait()
 
     return kernel
 
@@ -998,38 +1035,58 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     layout (factored [G*128, p*nlo] or classic [4, f_pad*num_bins]; fold
     with :func:`fold_hist`), nl [1, 1] i32 — left-child row count).
     """
+    return _partition_call(rows, scal, num_features=num_features,
+                           num_bins=num_bins, voff=voff, bpc=bpc,
+                           packed=packed, exact=exact, interpret=interpret,
+                           dbg_skip=dbg_skip, chunk=chunk, small=small)
+
+
+def _partition_call(rows, scal, *, num_features, num_bins, voff, bpc,
+                    packed, exact, interpret, dbg_skip, chunk, small):
+    """Shared pallas_call plumbing for the single-window
+    (:func:`partition_hist_pallas`, ``scal`` 1-D) and multi-window
+    (:func:`partition_hist_level_pallas`, ``scal`` [G, S]) launches: the
+    window count is the grid, the per-window scalar row is selected by
+    ``pl.program_id`` inside the kernel, and the hist/nl outputs are blocked
+    per grid step.  A single window is exactly the G=1 blocking, so both
+    entry points run the same kernels — which is what makes a level launch
+    bit-exact against a sequence of per-split launches."""
     n_pad, W = rows.shape
+    multiwin = scal.ndim == 2
+    nwin = scal.shape[0] if multiwin else 1
+    scal_width = scal.shape[-1]
     assert n_pad % CHUNK == 0, "pad the row store to a multiple of CHUNK"
     assert CHUNK % chunk == 0 and chunk % T == 0, \
         "bucketed chunk must divide the CHUNK padding contract"
     assert num_bins >= 32 and num_bins % 32 == 0, \
         "num_bins must be the >=32 kernel-block width (_pad_bins_pow2); " \
         "nibble-packed 16-bin data still scans at 32 lanes"
-    f_shard = scal.shape[0] == 13 + num_bins // 32
+    f_shard = scal_width == 13 + num_bins // 32
     if _use_factored(num_features, num_bins):
         hist_shape = _factored_out_shape(num_features, num_bins)
     else:
         assert not f_shard, \
             "the histogram feature window needs the factored path"
         hist_shape = (4, _padded_features(num_features, num_bins) * num_bins)
+    h0, h1 = hist_shape
 
     if small:
         kernel = _make_small_partition_kernel(
             n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
             voff=voff, bpc=bpc, packed=packed, exact=exact, f_shard=f_shard,
-            dbg_skip=dbg_skip, sc=chunk)
+            dbg_skip=dbg_skip, sc=chunk, multiwin=multiwin)
         rows_new, hist, nl = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
-                grid=(1,),
+                grid=(nwin,),
                 in_specs=[
                     pl.BlockSpec(memory_space=pl.ANY),       # rows
                 ],
                 out_specs=[
                     pl.BlockSpec(memory_space=pl.ANY),       # rows (aliased)
-                    pl.BlockSpec(memory_space=pltpu.VMEM),   # hist
-                    pl.BlockSpec(memory_space=pltpu.VMEM),   # nl
+                    pl.BlockSpec((h0, h1), lambda g, s: (g, 0)),  # hist
+                    pl.BlockSpec((1, 1), lambda g, s: (g, 0)),    # nl
                 ],
                 scratch_shapes=[
                     pltpu.VMEM((chunk, W), jnp.uint8),       # window tile in
@@ -1040,12 +1097,14 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
             ),
             out_shape=[
                 jax.ShapeDtypeStruct((n_pad, W), jnp.uint8),
-                jax.ShapeDtypeStruct(hist_shape, jnp.float32),
-                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((nwin * h0, h1), jnp.float32),
+                jax.ShapeDtypeStruct((nwin, 1), jnp.int32),
             ],
             input_output_aliases={1: 0},
             interpret=interpret,
         )(scal, rows)
+        if multiwin:
+            hist = hist.reshape(nwin, h0, h1)
         return rows_new, hist, nl
 
     nb_ring = _ring_depth(chunk)
@@ -1054,20 +1113,21 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     kernel = _make_partition_kernel(
         n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
         voff=voff, bpc=bpc, packed=packed, exact=exact, f_shard=f_shard,
-        dbg_skip=dbg_skip, chunk=chunk)
+        dbg_skip=dbg_skip, chunk=chunk, multiwin=multiwin)
     rows_new, _scratch, hist, nl = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(1,),
+            grid=(nwin,),
             in_specs=[
                 pl.BlockSpec(memory_space=pl.ANY),       # rows
             ],
             out_specs=[
                 pl.BlockSpec(memory_space=pl.ANY),       # rows out (aliased)
                 pl.BlockSpec(memory_space=pl.ANY),       # right-block scratch
-                pl.BlockSpec(memory_space=pltpu.VMEM),   # hist
-                pl.BlockSpec(memory_space=pltpu.SMEM),   # nl
+                pl.BlockSpec((h0, h1), lambda g, s: (g, 0)),  # hist
+                pl.BlockSpec((1, 1), lambda g, s: (g, 0),
+                             memory_space=pltpu.SMEM),        # nl
             ],
             scratch_shapes=[
                 pltpu.VMEM((NIN, chunk, W), jnp.uint8),  # streamed chunk ring
@@ -1090,13 +1150,57 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
         out_shape=[
             jax.ShapeDtypeStruct((n_pad, W), jnp.uint8),
             jax.ShapeDtypeStruct((n_pad, W), jnp.uint8),
-            jax.ShapeDtypeStruct(hist_shape, jnp.float32),
-            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((nwin * h0, h1), jnp.float32),
+            jax.ShapeDtypeStruct((nwin, 1), jnp.int32),
         ],
         input_output_aliases={1: 0},
         interpret=interpret,
     )(scal, rows)
+    if multiwin:
+        hist = hist.reshape(nwin, h0, h1)
     return rows_new, hist, nl
+
+
+def level_plan(n: int) -> tuple:
+    """Bucket-class schedule for LEVEL-batched dispatch (round 12): the same
+    size-bucket ladder as :func:`fused_bucket_plan`, reused as the per-level
+    class set.  A level's frontier windows are binned into these classes by
+    row count and each class gets at most ONE multi-window launch per level
+    (every frontier slot rides every class launch; out-of-class slots carry
+    ``wc = 0`` and are skipped in-kernel), so a tree costs at most
+    ``levels * len(level_plan(n))`` launches instead of one per split."""
+    return fused_bucket_plan(n)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_features", "num_bins", "voff", "bpc", "packed", "exact", "interpret",
+    "chunk", "small"))
+def partition_hist_level_pallas(rows: jax.Array, scals: jax.Array,
+                                *, num_features: int, num_bins: int,
+                                voff: int, bpc: int = 1,
+                                packed: bool = False, exact: bool = False,
+                                interpret: bool = False,
+                                chunk: int = CHUNK, small: bool = False):
+    """Multi-window fused split pass: ONE Pallas launch partitions + child-
+    histograms every window of ``scals`` ([G, S] — one
+    :func:`partition_hist_pallas` scalar row per window, same layout).
+
+    Windows must be pairwise disjoint (distinct leaves of one tree level
+    are, by construction); each is processed by its own grid step of the
+    SAME kernel the single-window entry point runs, so outputs are bit-exact
+    against G sequential single-window launches (pinned by
+    tests/test_partition_buckets.py).  Windows with ``wc = 0`` are skipped
+    in-kernel (identity partition, zero histogram) — the level dispatcher
+    masks out-of-class windows to 0 instead of compacting, keeping the grid
+    size trace-static.
+
+    Returns (rows_new [N_pad, W] u8, hist_raw [G, ...] f32 — per-window
+    smaller-child histograms in the kernel accumulator layout (fold each
+    with :func:`fold_hist`), nl [G, 1] i32 left-child counts)."""
+    return _partition_call(rows, scals, num_features=num_features,
+                           num_bins=num_bins, voff=voff, bpc=bpc,
+                           packed=packed, exact=exact, interpret=interpret,
+                           dbg_skip="", chunk=chunk, small=small)
 
 
 def fold_hist(hist_raw: jax.Array, num_features: int,

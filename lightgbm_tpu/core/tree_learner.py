@@ -25,6 +25,7 @@ the BinMappers, like Dataset::RealThreshold).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -35,7 +36,7 @@ from .histogram import (build_histogram, histogram_rows, pack_nibbles,
                         partition_buckets, _exact_hist, _pad_bins,
                         _pad_bins_pow2, _use_factored)
 from .partition import (CHUNK as _PCHUNK, fold_hist, fused_bucket_plan,
-                        partition_hist_pallas)
+                        partition_hist_level_pallas, partition_hist_pallas)
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
                     per_feature_best, per_feature_best_combined,
                     reduce_feature_best, sync_best, K_MIN_SCORE)
@@ -204,7 +205,8 @@ def _ffill_pair(flag: jax.Array, val: jax.Array):
                      "use_pallas", "has_categorical", "has_monotone",
                      "feat_num_bins", "packed_cols", "axis_name",
                      "comm_mode", "num_shards", "carried", "top_k",
-                     "hist_pool_slots", "bucket_plan", "pallas_interpret"))
+                     "hist_pool_slots", "bucket_plan", "pallas_interpret",
+                     "tree_grow_mode"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -224,6 +226,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            hist_pool_slots: int = 0,
                            bucket_plan=None,
                            pallas_interpret: bool = False,
+                           tree_grow_mode: str = "leaf",
                            rows_carry=None, extra=None, score_rate=None):
     """Leaf-wise growth with per-leaf physical row partitions.
 
@@ -254,6 +257,18 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     4096-row floor; ``None`` derives the schedule from the row count.
     ``pallas_interpret`` runs every Pallas kernel in interpret mode so the
     fused path (incl. this dispatch) is testable off-TPU.
+    ``tree_grow_mode`` (round 12): ``"leaf"`` (default) is the reference's
+    best-first growth — one fused split launch per grown leaf, L-1 launches
+    per tree.  ``"level"`` replays a ``max_depth``-driven BFS: each level's
+    whole frontier is split by at most ONE multi-window Pallas launch per
+    bucket class (:func:`lightgbm_tpu.core.partition.level_plan`), so a
+    depth-D tree costs <= D * len(plan) launches.  Frontier leaves are
+    processed in ascending leaf-id order; when the ``num_leaves`` budget
+    cannot cover a whole frontier, the lowest leaf ids win (with
+    ``max_depth <= 0`` the level schedule defaults to ceil(log2(L)) levels
+    — a complete tree exactly fills the leaf budget).  Level mode requires
+    the fused Pallas path and is incompatible with forced splits, CEGB,
+    histogram pooling and sharded growth (asserted at trace time).
     ``cegb``: optional (penalty_split [scalar], coupled [F], used0 [F]) cost
     penalties (cost_effective_gradient_boosting.hpp:50-61 DetlaGain):
     candidate gains lose tradeoff*penalty_split*num_data_in_leaf plus the
@@ -479,6 +494,21 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 return fused_branches[0](rows_m, scal_v)
             which = jnp.searchsorted(fused_bounds, wcount).astype(jnp.int32)
             return jax.lax.switch(which, fused_branches, rows_m, scal_v)
+
+    grow_level = tree_grow_mode == "level"
+    if tree_grow_mode not in ("leaf", "level"):
+        raise ValueError("unknown tree_grow_mode %r" % (tree_grow_mode,))
+    if grow_level:
+        assert fused, \
+            "tree_grow_mode=level needs the fused Pallas split path " \
+            "(TPU backend or pallas_interpret) and a CHUNK-padded row store"
+        assert forced is None and cegb is None, \
+            "tree_grow_mode=level is incompatible with forced splits / CEGB"
+        assert hist_pool_slots == 0, \
+            "tree_grow_mode=level needs the unbounded per-leaf histogram " \
+            "cache (histogram_pool_size is leaf-wise only)"
+        assert not axis_name, \
+            "tree_grow_mode=level runs on the serial learner only"
 
     contri = (jnp.maximum(jnp.asarray(params.feature_contri, f32), 0.0)
               if params.feature_contri else None)
@@ -1028,7 +1058,205 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                        force_on=st.force_on, fbc=fbc_m,
                        slot_of=slot_m, stamps=stamps_m)
 
-    if L > 1:
+    def level_step(d, Fcap, st: _PState) -> _PState:
+        """One BFS level (round 12): split EVERY splittable depth-``d`` leaf
+        with at most one multi-window Pallas launch per bucket class, then
+        perform the whole frontier's bookkeeping (hist subtraction, child
+        best-split search, tree-array updates) as batched scatters.
+
+        ``Fcap`` is the trace-static frontier bound (min(2^d, L-1)); dead
+        slots carry ``wc = 0`` windows (skipped in-kernel) and scatter to
+        the dropped index ``L``, the level-wise analogue of the leaf-wise
+        body's masked dead iteration."""
+        t = st.tree
+        leaves_i = jnp.arange(L, dtype=jnp.int32)
+        gains = jnp.where(leaves_i < t.num_leaves, st.bests.gain, K_MIN_SCORE)
+        mask = (t.leaf_depth == d) & (leaves_i < t.num_leaves) & (gains > 0.0)
+        # frontier leaves in ascending id order; budget overflow drops the
+        # highest ids (nonzero packs the found ids at the front)
+        found = jnp.nonzero(mask, size=Fcap, fill_value=L)[0].astype(jnp.int32)
+        rank = jnp.arange(Fcap, dtype=jnp.int32)
+        active = (found < L) & (rank < L - t.num_leaves)
+        nact = jnp.sum(active.astype(jnp.int32))
+        lsafe = jnp.minimum(found, L - 1)          # gather-safe leaf ids
+        leaf = jnp.where(active, found, L)         # scatter: L drops
+        kid = jnp.where(active, t.num_leaves + rank, L)
+        node = jnp.where(active, t.num_leaves - 1 + rank, L)
+
+        b = BestSplit(*[x[lsafe] for x in st.bests])         # fields [Fcap]
+        wb = jnp.where(active, st.begin[lsafe], 0)
+        wc = jnp.where(active, st.wcount[lsafe], 0)
+        left_smaller = b.left_count <= b.right_count
+
+        # ---- per-window scalar rows (the leaf-wise fused head, batched) --
+        fid = b.feature
+        if feat.offset is None:
+            unf = jnp.zeros((Fcap,), jnp.int32)
+            eoff = jnp.zeros((Fcap,), jnp.int32)
+        else:
+            unf = jnp.ones((Fcap,), jnp.int32)
+            eoff = feat.offset[fid].astype(jnp.int32)
+        head = jnp.stack([
+            wb, wc, _feature_column(fid, feat).astype(jnp.int32),
+            b.threshold.astype(jnp.int32),
+            b.default_left.astype(jnp.int32),
+            feat.missing_type[fid].astype(jnp.int32),
+            feat.num_bin[fid].astype(jnp.int32),
+            feat.default_bin[fid].astype(jnp.int32),
+            feat.is_categorical[fid].astype(jnp.int32),
+            left_smaller.astype(jnp.int32), unf, eoff], axis=1)
+        nw = num_bins // 32
+        bw = jax.lax.bitcast_convert_type(b.cat_bitset, jnp.int32)
+        if bw.shape[1] < nw:
+            bw = jnp.concatenate(
+                [bw, jnp.zeros((Fcap, nw - bw.shape[1]), jnp.int32)], axis=1)
+        scal = jnp.concatenate([head, bw[:, :nw]], axis=1)
+
+        # ---- one multi-window launch per bucket class ----
+        # every frontier slot rides every class launch; out-of-class slots
+        # are masked to wc = 0 (skipped in-kernel), so the grid stays
+        # trace-static and each slot is partitioned exactly once.  Summing
+        # the per-class outputs recovers each slot's histogram/count (the
+        # other classes contributed exact zeros).
+        if fused_bounds is None:
+            class_of = jnp.zeros((Fcap,), jnp.int32)
+        else:
+            class_of = jnp.searchsorted(fused_bounds, wc).astype(jnp.int32)
+        rows_m = st.rows
+        nl = jnp.zeros((Fcap,), jnp.int32)
+        hist_raw = None
+        for ci, (small_k, chunk_k, _) in enumerate(plan):
+            in_c = (class_of == ci) & active & (wc > 0)
+            # zero wb AND wc for out-of-class slots: the pipelined kernels
+            # derive their chunk count from the window HEAD offset too, so
+            # a fully-zeroed dead window runs zero chunks
+            scal_c = scal.at[:, 0].set(jnp.where(in_c, wb, 0)).at[:, 1].set(
+                jnp.where(in_c, wc, 0))
+            rows_m, hist_c, nl_c = partition_hist_level_pallas(
+                rows_m, scal_c, num_features=hist_fc, num_bins=num_bins,
+                voff=voff, bpc=bpc, packed=bool(packed_cols),
+                exact=_exact_hist(), chunk=chunk_k, small=small_k,
+                interpret=pallas_interpret)
+            nl = nl + nl_c[:, 0]
+            hist_raw = hist_c if hist_raw is None else hist_raw + hist_c
+        hist_small = jax.vmap(
+            lambda h: fold_hist(h, hist_fc, num_bins))(hist_raw)
+
+        # ---- subtraction trick + child best-split search, batched ----
+        parent_hist = st.hist[lsafe]
+        hist_larger = parent_hist - hist_small
+        ls4 = left_smaller.reshape((-1,) + (1,) * (hist_small.ndim - 1))
+        hist_left = jnp.where(ls4, hist_small, hist_larger)
+        hist_right = jnp.where(ls4, hist_larger, hist_small)
+        hist_new = st.hist.at[leaf].set(hist_left, mode="drop")
+        hist_new = hist_new.at[kid].set(hist_right, mode="drop")
+
+        # monotone constraint propagation (vectorized leaf-wise rule)
+        pmin, pmax = st.cmin[lsafe], st.cmax[lsafe]
+        if has_monotone and feat.monotone is not None:
+            mono_f = feat.monotone[fid]
+        else:
+            mono_f = jnp.zeros((Fcap,), jnp.int32)
+        is_num = ~feat.is_categorical[fid]
+        mid = (b.left_output + b.right_output) * 0.5
+        lmin = jnp.where(is_num & (mono_f < 0), jnp.maximum(pmin, mid), pmin)
+        lmax = jnp.where(is_num & (mono_f > 0), jnp.minimum(pmax, mid), pmax)
+        rmin = jnp.where(is_num & (mono_f > 0), jnp.maximum(pmin, mid), pmin)
+        rmax = jnp.where(is_num & (mono_f < 0), jnp.minimum(pmax, mid), pmax)
+        cmin_new = st.cmin.at[leaf].set(lmin, mode="drop").at[kid].set(
+            rmin, mode="drop")
+        cmax_new = st.cmax.at[leaf].set(lmax, mode="drop").at[kid].set(
+            rmax, mode="drop")
+
+        child_best = vmapped_best(
+            jnp.concatenate([hist_left, hist_right], axis=0),
+            jnp.concatenate([b.left_sum_grad, b.right_sum_grad]),
+            jnp.concatenate([b.left_sum_hess, b.right_sum_hess]),
+            jnp.concatenate([b.left_count, b.right_count]),
+            jnp.concatenate([lmin, rmin]), jnp.concatenate([lmax, rmax]),
+            st.feat_used)
+        bests = BestSplit(*[
+            f.at[leaf].set(c[:Fcap], mode="drop").at[kid].set(
+                c[Fcap:], mode="drop")
+            for f, c in zip(st.bests, child_best)])
+
+        # ---- parent child-pointer fixup (siblings in one frontier target
+        # the same parent node through DIFFERENT lc/rc slots, so the
+        # scatter indices stay unique among active slots) ----
+        parent = t.leaf_parent[lsafe]
+        pidx = jnp.maximum(parent, 0)
+        lc, rc = t.left_child, t.right_child
+        upd_l = active & (parent >= 0) & (lc[pidx] == ~lsafe)
+        upd_r = active & (parent >= 0) & (rc[pidx] == ~lsafe)
+        lc = lc.at[jnp.where(upd_l, pidx, L)].set(node, mode="drop")
+        rc = rc.at[jnp.where(upd_r, pidx, L)].set(node, mode="drop")
+        lc = lc.at[node].set(~lsafe, mode="drop")
+        rc = rc.at[node].set(~kid, mode="drop")
+
+        tree_new = TreeArrays(
+            split_feature=t.split_feature.at[node].set(b.feature,
+                                                       mode="drop"),
+            threshold_bin=t.threshold_bin.at[node].set(b.threshold,
+                                                       mode="drop"),
+            split_gain=t.split_gain.at[node].set(b.gain, mode="drop"),
+            default_left=t.default_left.at[node].set(b.default_left,
+                                                     mode="drop"),
+            left_child=lc,
+            right_child=rc,
+            internal_value=t.internal_value.at[node].set(
+                t.leaf_value[lsafe], mode="drop"),
+            internal_weight=t.internal_weight.at[node].set(
+                t.leaf_weight[lsafe], mode="drop"),
+            internal_count=t.internal_count.at[node].set(
+                b.left_count + b.right_count, mode="drop"),
+            leaf_value=t.leaf_value.at[leaf].set(
+                jnp.nan_to_num(b.left_output), mode="drop").at[kid].set(
+                jnp.nan_to_num(b.right_output), mode="drop"),
+            leaf_weight=t.leaf_weight.at[leaf].set(
+                b.left_sum_hess, mode="drop").at[kid].set(
+                b.right_sum_hess, mode="drop"),
+            leaf_count=t.leaf_count.at[leaf].set(
+                b.left_count, mode="drop").at[kid].set(
+                b.right_count, mode="drop"),
+            leaf_parent=t.leaf_parent.at[leaf].set(
+                node, mode="drop").at[kid].set(node, mode="drop"),
+            leaf_depth=t.leaf_depth.at[leaf].set(
+                d + 1, mode="drop").at[kid].set(d + 1, mode="drop"),
+            cat_bitset=t.cat_bitset.at[node].set(b.cat_bitset, mode="drop"),
+            num_leaves=t.num_leaves + nact,
+            row_leaf=t.row_leaf)
+
+        begin = st.begin.at[kid].set(wb + nl, mode="drop")
+        wcount = st.wcount.at[leaf].set(nl, mode="drop").at[kid].set(
+            wc - nl, mode="drop")
+        lsum_g = st.lsum_g.at[leaf].set(b.left_sum_grad,
+                                        mode="drop").at[kid].set(
+            b.right_sum_grad, mode="drop")
+        lsum_h = st.lsum_h.at[leaf].set(b.left_sum_hess,
+                                        mode="drop").at[kid].set(
+            b.right_sum_hess, mode="drop")
+        return _PState(tree=tree_new, hist=hist_new, bests=bests,
+                       cont=nact > 0, cmin=cmin_new, cmax=cmax_new,
+                       begin=begin, wcount=wcount, rows=rows_m,
+                       lsum_g=lsum_g, lsum_h=lsum_h,
+                       feat_used=st.feat_used, force_on=st.force_on,
+                       fbc=st.fbc, slot_of=st.slot_of, stamps=st.stamps)
+
+    if grow_level and L > 1:
+        # static level schedule: a depth-D tree is at most D * bucket-class
+        # launches.  With no max_depth the schedule covers the complete tree
+        # that exactly fills the leaf budget; an early-exhausted frontier
+        # (no positive gains / budget spent) makes the remaining levels
+        # dead Fcap-slot launches of empty windows.  The leaf budget caps
+        # the schedule regardless of max_depth: every live level grows at
+        # least one leaf, so levels past L-1 are guaranteed dead — without
+        # the cap a "just in case" max_depth=63 guard would unroll 63
+        # level_steps and dispatch MORE than leaf-wise ever does.
+        n_levels = (min(max_depth, L - 1) if max_depth > 0
+                    else max(1, int(np.ceil(np.log2(L)))))
+        for d in range(n_levels):
+            state = level_step(d, min(1 << d, L - 1), state)
+    elif L > 1:
         state = jax.lax.fori_loop(1, L, body, state)
 
     # reconstruct per-row leaf assignment from the windows + permutation
@@ -1253,6 +1481,19 @@ class SerialTreeLearner:
         # pin a plan and flip pallas_interpret to run the fused path off-TPU
         self.bucket_plan = None
         self.pallas_interpret = False
+        if os.environ.get("LIGHTGBM_TPU_PALLAS_INTERPRET", "0") == "1":
+            # force the fused Pallas path in interpret mode off-TPU — the
+            # hook CLI-driven child processes (fault injection, dryruns) use
+            # to exercise the fused/level dispatch without an accelerator
+            self.use_pallas = True
+            self.pallas_interpret = True
+        # round-12 level-batched dispatch (tree_grow_mode=level): BFS growth
+        # with one multi-window launch per bucket class per level; resolved
+        # to the effective mode lazily (tests flip use_pallas/interpret on
+        # the instance after construction)
+        self.tree_grow_mode = str(getattr(config, "tree_grow_mode", "leaf")
+                                  or "leaf")
+        self._grow_mode_warned = False
         self.grouped = bool(dataset.is_bundled and self.supports_groups)
         # histogram (kernel) width is the MXU-friendly power of two; the
         # per-feature scan width stays lane-padded only when group columns
@@ -1425,6 +1666,55 @@ class SerialTreeLearner:
             return jnp.pad(arr, pad_width, constant_values=value)
         return arr
 
+    def effective_grow_mode(self) -> str:
+        """The growth mode this learner's builds actually run: ``level``
+        only when the fused Pallas path is live and no leaf-wise-only
+        feature (forced splits, CEGB, histogram pooling, parallel comm) is
+        active; anything else falls back to ``leaf`` with one warning."""
+        if self.tree_grow_mode != "level":
+            return "leaf"
+        blockers = []
+        if not self.use_pallas:
+            blockers.append("non-TPU backend (fused Pallas path required)")
+        if getattr(self, "comm", None) is not None:
+            blockers.append("parallel tree learner")
+        if self.forced is not None:
+            blockers.append("forced splits")
+        if self.cegb is not None:
+            blockers.append("CEGB")
+        if self.hist_pool_slots:
+            blockers.append("histogram_pool_size")
+        if blockers:
+            if not self._grow_mode_warned:
+                from ..utils.log import Log
+                Log.warning("tree_grow_mode=level unavailable (%s); growing "
+                            "leaf-wise", "; ".join(blockers))
+                self._grow_mode_warned = True
+            return "leaf"
+        return "level"
+
+    def level_classes(self) -> int:
+        """Bucket-class count of the level-batched dispatch schedule."""
+        plan = (self.bucket_plan if self.bucket_plan is not None
+                else fused_bucket_plan(self.bins.shape[0]))
+        return len(plan)
+
+    def level_count(self) -> int:
+        """Static level-schedule length of tree_grow_mode=level builds
+        (same leaf-budget cap as the builder's schedule)."""
+        return (min(self.max_depth, self.num_leaves - 1)
+                if self.max_depth > 0
+                else max(1, int(np.ceil(np.log2(self.num_leaves)))))
+
+    def launches_per_tree(self) -> int:
+        """Split-dispatch launches one tree build issues: L-1 leaf-wise
+        (one fused split pass per grown leaf), levels * bucket-classes in
+        level mode — the quantity the always-on ``tree_kernel_launches``
+        counter (obs/launches.py) accumulates."""
+        if self.effective_grow_mode() == "level":
+            return self.level_count() * self.level_classes()
+        return self.num_leaves - 1
+
     def train(self, grad: jax.Array, hess: jax.Array,
               num_data_in_bag, feature_mask: Optional[jax.Array] = None
               ) -> TreeArrays:
@@ -1437,6 +1727,9 @@ class SerialTreeLearner:
                 else (self.cegb[0], self.cegb[1], self.cegb_used,
                       self.cegb[2]))
         lazy_active = cegb is not None and cegb[3] is not None
+        from ..obs import launches as _launches
+        grow_mode = self.effective_grow_mode()
+        _launches.record(grow_mode, self.launches_per_tree())
         with FunctionTimer("Partition::BuildTree(dispatch)"), \
                 _annotate("partition_build_tree"):
             out = build_tree_partitioned(
@@ -1455,7 +1748,8 @@ class SerialTreeLearner:
                 packed_cols=self.packed_cols,
                 hist_pool_slots=self.hist_pool_slots,
                 bucket_plan=self.bucket_plan,
-                pallas_interpret=self.pallas_interpret)
+                pallas_interpret=self.pallas_interpret,
+                tree_grow_mode=grow_mode)
         if lazy_active:
             # per-(row, feature) paid bits live for the whole training
             # (feature_used_in_data_)

@@ -1,0 +1,86 @@
+"""Split-dispatch launch accounting: kernel launches per tree build.
+
+Round 12's level-batched dispatcher exists to cut the number of fused
+split-kernel launches per tree from L-1 (one per grown leaf) to
+``levels * bucket-classes`` — this module is the live gauge that pins the
+drop, next to the recompile gauge (:mod:`.recompile`) and with the same
+contract: counting is ALWAYS on (one integer add per *tree build dispatch*,
+never per row or per split), so tests and the multichip dryrun can assert
+the launch structure without configuring a telemetry run.  When a telemetry
+run IS active, launches also bump its ``tree_kernel_launches`` counter so
+the JSONL artifact and the end-of-run summary carry them.
+
+The counts are attributed per growth mode (``leaf`` / ``level``)::
+
+    {"leaf": 254, "level": 24}
+
+Launch counts are trace-static per build configuration (the builder's
+dispatch structure is compiled, not data-dependent), so the recording site
+is the host-side dispatch: ``SerialTreeLearner.train`` for per-iteration
+builds and ``GBDT.train_chunk`` for the fused ``lax.scan`` (which runs the
+same build once per in-scan iteration).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+_trees: Dict[str, int] = {}
+
+
+def record(mode: str, launches_per_tree: int, trees: int = 1) -> None:
+    """Record ``trees`` tree builds of ``launches_per_tree`` launches each
+    under growth mode ``mode`` ("leaf" / "level")."""
+    n = int(launches_per_tree) * int(trees)
+    with _lock:
+        _counts[mode] = _counts.get(mode, 0) + n
+        _trees[mode] = _trees.get(mode, 0) + int(trees)
+    from . import active
+    tele = active()
+    if tele is not None:
+        tele.counter("tree_kernel_launches").inc(n)
+        tele.counter("trees_built").inc(int(trees))
+
+
+def counts() -> Dict[str, int]:
+    """{mode: total launches} since process start (or the last reset)."""
+    with _lock:
+        return dict(_counts)
+
+
+def trees() -> Dict[str, int]:
+    """{mode: tree builds} since process start (or the last reset)."""
+    with _lock:
+        return dict(_trees)
+
+
+def total(mode: Optional[str] = None) -> int:
+    with _lock:
+        return sum(n for m, n in _counts.items()
+                   if mode is None or m == mode)
+
+
+def per_tree(mode: Optional[str] = None) -> Optional[float]:
+    """Average launches per tree build, the headline the summary shows."""
+    with _lock:
+        nt = sum(n for m, n in _trees.items() if mode is None or m == mode)
+        if not nt:
+            return None
+        nl = sum(n for m, n in _counts.items() if mode is None or m == mode)
+    return nl / nt
+
+
+def reset() -> None:
+    """Zero the counters — pin a loop's launch structure from a clean
+    baseline (same idiom as recompile.reset)."""
+    with _lock:
+        _counts.clear()
+        _trees.clear()
+
+
+def as_flat_dict() -> Dict[str, int]:
+    """{"mode": launches} — the summary-JSON form."""
+    with _lock:
+        return dict(sorted(_counts.items()))

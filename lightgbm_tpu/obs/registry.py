@@ -249,8 +249,11 @@ class Telemetry:
         # process; snapshotting both here lets report.summarize attribute
         # only THIS run's scope time and cache misses
         self.timer_baseline = global_timer.totals()
+        from . import launches as _launches
         from . import recompile as _recompile
         self.recompile_baseline = _recompile.counts()
+        self.launch_baseline = _launches.counts()
+        self.launch_tree_baseline = _launches.trees()
         self.event("run_start", **(meta or {}))
 
     # ---- metrics passthrough ----

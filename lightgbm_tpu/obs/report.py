@@ -30,7 +30,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Optional
 
-from . import recompile
+from . import launches, recompile
 from .registry import EVENT_SCHEMA_VERSION, Telemetry
 
 
@@ -67,6 +67,22 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
         delta = n - rc_base.get(key, 0)
         if delta > 0:
             run_recompiles["%s|%s" % key] = delta
+    # split-kernel launch accounting (round 12), likewise run-scoped: total
+    # launches and launches-per-tree attributed per growth mode so the
+    # leaf-wise L-1 vs level-wise depth*classes structure reads off the
+    # artifact directly
+    lb = getattr(tele, "launch_baseline", {})
+    tb = getattr(tele, "launch_tree_baseline", {})
+    run_launches = {}
+    launch_total = 0
+    for mode, n in launches.counts().items():
+        dl = n - lb.get(mode, 0)
+        dt = launches.trees().get(mode, 0) - tb.get(mode, 0)
+        if dl > 0:
+            run_launches[mode] = {
+                "launches": dl, "trees": dt,
+                "per_tree": (dl / dt) if dt else None}
+            launch_total += dl
     # resilience rollup (lightgbm_tpu/resilience.py): every fault the run
     # absorbed, as one named subsection — the drill report reads this
     counters = snap["counters"]
@@ -95,6 +111,8 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
         "histograms": hists,
         "recompiles": run_recompiles,
         "recompile_total": sum(run_recompiles.values()),
+        "tree_kernel_launches": run_launches,
+        "tree_kernel_launch_total": launch_total,
         "resilience": resilience,
         "mfu": gauges.get("mfu"),
         "device_util": gauges.get("device_util"),
@@ -124,6 +142,16 @@ def human_table(summary: Dict[str, Any]) -> str:
     row("recompiles (total)", "%d" % summary.get("recompile_total", 0))
     for key, n in sorted((summary.get("recompiles") or {}).items()):
         row("  recompile %s" % key, "%d" % n)
+    if summary.get("tree_kernel_launch_total"):
+        row("tree kernel launches (total)",
+            "%d" % summary["tree_kernel_launch_total"])
+        for mode, d in sorted((summary.get("tree_kernel_launches")
+                               or {}).items()):
+            per = d.get("per_tree")
+            row("  launches[%s]" % mode,
+                "%d over %d trees (%s/tree)"
+                % (d.get("launches", 0), d.get("trees", 0),
+                   "-" if per is None else "%.1f" % per))
     res = summary.get("resilience") or {}
     shown = {k: v for k, v in sorted(res.items())
              if (isinstance(v, (int, float)) and v)

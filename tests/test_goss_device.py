@@ -1,0 +1,108 @@
+"""Round-12 device-side GOSS top-k: the jax.lax.top_k selection must be
+bit-equal to the host np.argsort path (stable descending order, ties broken
+toward the lower index), the bagging RNG stream must be untouched, and the
+telemetry gauges unchanged."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.boosting.goss import GOSS
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _goss_pair(monkeypatch, n=1500, iters=4, **params):
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(n, 5))
+    y = X[:, 0] * 2.0 + rng.normal(scale=0.05, size=n)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=16)
+    cfg = Config(dict(objective="regression", boosting="goss",
+                      num_iterations=iters, num_leaves=6, min_data_in_leaf=2,
+                      learning_rate=0.5, **params))
+    b_dev = GOSS(cfg, ds, create_objective("regression", cfg))
+    assert b_dev._goss_device
+    monkeypatch.setenv("LIGHTGBM_TPU_GOSS_HOST", "1")
+    b_host = GOSS(cfg, ds, create_objective("regression", cfg))
+    assert not b_host._goss_device
+    return b_dev, b_host
+
+
+def test_goss_device_matches_host_model(monkeypatch):
+    b_dev, b_host = _goss_pair(monkeypatch)
+    b_dev.train()
+    b_host.train()
+    assert b_dev.save_model_to_string() == b_host.save_model_to_string()
+    np.testing.assert_array_equal(np.asarray(b_dev.train_score),
+                                  np.asarray(b_host.train_score))
+
+
+def test_goss_selection_tie_break_parity(monkeypatch):
+    """Duplicate keys: lax.top_k's lower-index tie preference must replay
+    np.argsort(-key, kind='stable') exactly, including which tied rows make
+    the top-k cut and how the remainder order maps the sampled positions."""
+    b_dev, b_host = _goss_pair(monkeypatch, n=1500, iters=1)
+    key = np.tile(np.asarray([3.0, 1.0, 3.0, 2.0, 0.5, 3.0, 2.0, 1.0],
+                             np.float32), 25)   # 200 rows, heavy ties
+    sampled = np.asarray([0, 7, 31, 150])
+    w_dev = np.asarray(b_dev._select_weights_device(
+        jnp.asarray(key), 40, sampled, 7.5))
+    w_host = np.asarray(b_host._select_weights_host(
+        key, 40, sampled, 7.5))
+    np.testing.assert_array_equal(w_dev, w_host)
+    assert (w_dev == 1.0).sum() == 40 and (w_dev == 7.5).sum() == len(sampled)
+
+
+def test_goss_rng_stream_and_gauges_unchanged(tmp_path):
+    """The device selection consumes the SAME _bag_rng call as the host
+    path (checkpoint replay invariant) and keeps the goss_top_k /
+    goss_other_k gauges + goss_select events."""
+    n = 1500
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(n, 5))
+    y = X[:, 0] * 2.0 + rng.normal(scale=0.05, size=n)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=16)
+    cfg = Config(objective="regression", boosting="goss", num_iterations=3,
+                 num_leaves=6, min_data_in_leaf=2, learning_rate=0.5)
+    b = GOSS(cfg, ds, create_objective("regression", cfg))
+    tele = obs.configure(out=str(tmp_path / "g.jsonl"), freq=1)
+    b.train()
+    top_k = max(1, int(n * cfg.top_rate))
+    assert tele.gauge("goss_top_k").value == top_k
+    assert tele.gauge("goss_other_k").value == max(1, int(n * cfg.other_rate))
+    kinds = [e["kind"] for e in tele.events]
+    assert "goss_select" in kinds
+    obs.disable()
+    # rng stream: a fresh RandomState replaying the same choice calls lands
+    # at the same state the booster's rng reached
+    ref = np.random.RandomState(cfg.bagging_seed)
+    warm = int(1.0 / cfg.learning_rate)
+    for _ in range(max(0, cfg.num_iterations - warm)):
+        ref.choice(n - top_k, size=min(max(1, int(n * cfg.other_rate)),
+                                       n - top_k), replace=False)
+    got = b._bag_rng.randint(1 << 30)
+    want = ref.randint(1 << 30)
+    assert got == want
+
+
+def test_goss_device_failure_falls_back_to_host(monkeypatch):
+    """A device-selection failure degrades to the bit-equal host path (one
+    warning, run continues) instead of raising — the round-11 idiom."""
+    b_dev, b_host = _goss_pair(monkeypatch)
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated top_k failure")
+
+    b_dev._select_weights_device = boom
+    b_dev.train()
+    assert not b_dev._goss_device  # demoted for the rest of the run
+    b_host.train()
+    assert b_dev.save_model_to_string() == b_host.save_model_to_string()

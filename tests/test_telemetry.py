@@ -512,3 +512,102 @@ def test_nan_trip_counter(tmp_path):
     assert tele.counter("nan_policy_trips").value == 1
     kinds = [e["kind"] for e in tele.events]
     assert "nan_trip" in kinds
+
+
+# ---- round 12: split-kernel launch accounting (always-on, like recompile) ----
+
+
+def _fused_booster(iters=2, **params):
+    """4096-row booster pinned to the interpret fused path (n % CHUNK == 0
+    so the Pallas split pass engages off-TPU)."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+    n = 4096
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(n, 8))
+    y = X[:, 0] * 1.5 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=n)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=16)
+    cfg = Config(dict(objective="regression", num_iterations=iters,
+                      min_data_in_leaf=2, **params))
+    b = GBDT(cfg, ds, create_objective("regression", cfg))
+    b.learner.use_pallas = True
+    b.learner.pallas_interpret = True
+    return b
+
+
+def test_tree_kernel_launches_leaf_wise_is_leaves_minus_one():
+    """Leaf-wise growth dispatches exactly L-1 split launches per tree (the
+    builder's fori_loop always runs its full budget; dead iterations still
+    launch an empty-window pass)."""
+    from lightgbm_tpu.obs import launches
+    launches.reset()
+    b = _fused_booster(iters=2, num_leaves=8)
+    assert b._can_fuse_iters()
+    b.train_chunk(2)
+    assert launches.counts() == {"leaf": 2 * 7}
+    assert launches.per_tree("leaf") == 7.0
+    assert b.learner.launches_per_tree() == 7
+
+
+def test_tree_kernel_launches_level_wise_bounded_by_depth_times_classes():
+    """Level mode drops launches-per-tree from L-1 to
+    <= depth * bucket-classes — the round-12 acceptance pin."""
+    from lightgbm_tpu.obs import launches
+    launches.reset()
+    b = _fused_booster(iters=2, num_leaves=8, max_depth=3,
+                       tree_grow_mode="level")
+    assert b.learner.effective_grow_mode() == "level"
+    b.train_chunk(2)
+    classes = b.learner.level_classes()
+    per_tree = launches.per_tree("level")
+    assert per_tree is not None and per_tree <= 3 * classes
+    assert launches.counts()["level"] == 2 * 3 * classes
+    # strictly fewer dispatches than the leaf-wise L-1 for the same tree
+    assert per_tree < b.config.num_leaves - 1
+
+
+def test_tree_kernel_launches_per_iteration_path_counts_too():
+    """The non-fused per-iteration path records through
+    SerialTreeLearner.train (no pallas required: the counter tracks the
+    builder's split-dispatch structure)."""
+    from lightgbm_tpu.obs import launches
+    b, _, _ = _toy_booster(num_iterations=2)
+    b._fuse_failed = True  # force the per-iteration path
+    launches.reset()
+    b.train_chunk(2)
+    assert launches.counts() == {"leaf": 2 * (b.config.num_leaves - 1)}
+
+
+def test_tree_kernel_launches_in_summary_and_events(tmp_path):
+    """A telemetry run's summary carries the run-scoped launch accounting
+    (per growth mode, with launches-per-tree) and the registry counter."""
+    from lightgbm_tpu.obs import launches
+    from lightgbm_tpu.obs.report import finalize_run
+    path = str(tmp_path / "t.jsonl")
+    b = _fused_booster(iters=2, num_leaves=8, max_depth=3,
+                       tree_grow_mode="level")
+    tele = obs.configure(out=path, freq=1)
+    b.train_chunk(2)
+    summary = finalize_run(tele, gbdt=b, wall_s=1.0, iters=2)
+    obs.disable()
+    lv = summary["tree_kernel_launches"]["level"]
+    assert lv["trees"] == 2
+    assert lv["launches"] == summary["tree_kernel_launch_total"]
+    assert lv["per_tree"] <= 3 * b.learner.level_classes()
+    assert summary["counters"]["tree_kernel_launches"] == lv["launches"]
+    table = __import__("lightgbm_tpu.obs.report",
+                       fromlist=["human_table"]).human_table(summary)
+    assert "launches[level]" in table
+
+
+def test_level_schedule_capped_by_leaf_budget():
+    """A 'just in case' huge max_depth must not blow up the level schedule:
+    every live level grows >= 1 leaf, so levels past num_leaves-1 are
+    guaranteed dead and the static schedule (and with it the launch
+    counter's per-tree bound) is capped at L-1."""
+    b = _fused_booster(iters=1, num_leaves=8, max_depth=63,
+                       tree_grow_mode="level")
+    assert b.learner.level_count() == 7
+    assert b.learner.launches_per_tree() == 7 * b.learner.level_classes()

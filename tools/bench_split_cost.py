@@ -59,6 +59,15 @@ def parse_args(argv=None):
                     help="force Pallas interpret mode (automatic off-TPU)")
     ap.add_argument("--json", default="",
                     help="write results to this JSON path")
+    ap.add_argument("--level", action="store_true",
+                    help="measure the round-12 level-batched dispatch: "
+                         "per-split cost of one multi-window launch vs a "
+                         "sequence of single-window launches over the same "
+                         "frontier (updates the JSON's 'level' section)")
+    ap.add_argument("--frontier", type=int, default=254,
+                    help="largest frontier (window count) the --level sweep "
+                         "measures (default 254 = a full 255-leaf level "
+                         "set)")
     return ap.parse_args(argv)
 
 
@@ -84,8 +93,99 @@ def fit_line(xs, ys):
     return float(coef[0]), float(coef[1])
 
 
+def level_main(args):
+    """--level: launches-per-tree of leaf vs level growth on REAL fused tree
+    builds, plus the measured per-launch dispatch floor.
+
+    The per-split fixed cost the bucket schedule could not erase is the
+    per-LAUNCH intercept (this tool's base sweep fits it); level batching
+    divides it by the launch-count drop.  So the quantity reported here is
+
+        intercept_amortization = launches_per_tree(leaf) /
+                                 launches_per_tree(level)
+
+    read from the always-on ``tree_kernel_launches`` counter over actual
+    builds (a full ``--frontier``+1-leaf budget, depth ceil(log2(L))) —
+    per-split intercept = launches * per-launch-intercept / splits, so the
+    ratio IS the per-split intercept amortization at that frontier.
+    Wall-clock per mode is recorded as supporting data; NOTE that off-TPU
+    it is NOT evidence for or against batching — a Pallas interpret grid
+    step costs about as much as a whole separate dispatch (pure interpret
+    machinery with no hardware counterpart), which is exactly the fixed
+    cost that is ~0 in a compiled Mosaic grid.  The hardware protocol in
+    PERF.md round 12 re-measures the walls on a TPU."""
+    import math
+    import jax
+    import numpy as np
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.obs import launches
+    from lightgbm_tpu.objective import create_objective
+
+    interpret = args.interpret or jax.default_backend() != "tpu"
+    L = max(4, args.frontier + 1)           # full frontier = L-1 splits
+    depth = max(1, int(math.ceil(math.log2(L))))
+    n = 16384
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(n, args.features))
+    y = (X[:, 0] * 1.5 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n))
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=16)
+
+    def build_one(mode):
+        cfg = Config(objective="regression", num_leaves=L, max_depth=depth,
+                     num_iterations=1, min_data_in_leaf=2,
+                     tree_grow_mode=mode, verbosity=-1)
+        b = GBDT(cfg, ds, create_objective("regression", cfg))
+        if interpret:
+            b.learner.use_pallas = True
+            b.learner.pallas_interpret = True
+        assert b.learner.effective_grow_mode() == mode
+        launches.reset()
+        t0 = time.perf_counter()
+        b.train_chunk(1)
+        wall = time.perf_counter() - t0
+        per_tree = launches.per_tree(mode)
+        return per_tree, wall, b.learner.level_classes()
+
+    print("level-batched dispatch (%s): %d-leaf budget, depth %d"
+          % ("interpret" if interpret else "device", L, depth))
+    leaf_pt, leaf_wall, classes = build_one("leaf")
+    level_pt, level_wall, _ = build_one("level")
+    ratio = leaf_pt / max(level_pt, 1e-12)
+    print("  leaf : %6.0f launches/tree  (wall %.2fs incl. compile)"
+          % (leaf_pt, leaf_wall))
+    print("  level: %6.0f launches/tree  (wall %.2fs incl. compile; "
+          "<= depth*classes = %d*%d)" % (level_pt, level_wall, depth,
+                                         classes))
+    bar = "PASS" if ratio >= 4.0 else "FAIL"
+    print("per-split launch intercept amortized %.1fx at the %d-leaf "
+          "frontier (acceptance bar >= 4x: %s)" % (ratio, L - 1, bar))
+    level = {"mode": "interpret" if interpret else "device",
+             "num_leaves": L, "depth": depth, "bucket_classes": classes,
+             "launches_per_tree": {"leaf": leaf_pt, "level": level_pt},
+             "wall_s": {"leaf": leaf_wall, "level": level_wall},
+             "wall_note": "interpret walls carry per-grid-step interpreter "
+                          "overhead with no hardware counterpart; TPU "
+                          "protocol in PERF.md round 12",
+             "intercept_amortization": ratio}
+
+    if args.json:
+        results = {}
+        if os.path.exists(args.json):
+            with open(args.json) as fh:
+                results = json.load(fh)
+        results["level"] = level
+        with open(args.json, "w") as fh:
+            json.dump(results, fh, indent=1)
+        print("wrote", args.json)
+    return level
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.level:
+        return level_main(args)
     import jax
     import jax.numpy as jnp
     import numpy as np

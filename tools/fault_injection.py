@@ -32,6 +32,12 @@ Scenarios (each prints PASS/FAIL and exits nonzero on failure):
                utils/file_io.py) and the checkpoint lands; persistent ENOSPC
                skips THAT checkpoint and training completes anyway (periodic
                durability is best-effort, never fatal to a healthy run).
+  level-preempt  The round-12 level-batched dispatch (tree_grow_mode=level +
+               trees_per_chunk, fused Pallas path in interpret mode via
+               LIGHTGBM_TPU_PALLAS_INTERPRET=1) under the SIGTERM drill:
+               emergency checkpoint at the chunk boundary, exit 75, resume
+               bit-exact — the checkpoint/preemption invariants hold under
+               the new dispatch shape.
   all          Run every scenario.
 
 ``--matrix`` runs every scenario, prints a pass/fail table, and writes a
@@ -416,7 +422,110 @@ def scenario_enospc(workdir: str) -> None:
           "checkpoints landed")
 
 
+# ---- level-preempt: the round-12 level-batched dispatch under the same
+# preemption drill (SIGTERM -> emergency checkpoint -> bit-exact resume) ----
+
+_LEVEL_TRAIN_SRC = r"""
+import os, sys, signal
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# engage the fused Pallas path (interpret mode) off-TPU so
+# tree_grow_mode=level actually dispatches level-batched launches
+os.environ["LIGHTGBM_TPU_PALLAS_INTERPRET"] = "1"
+
+def build(n_iter, snapshot_freq):
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.metric.metric import create_metrics
+    from lightgbm_tpu.objective import create_objective
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-2, 2, size=(400, 5))
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2)
+         + 0.1 * rng.normal(size=400)).astype(np.float32)
+    cfg = Config(objective="regression", num_leaves=8, max_depth=3,
+                 min_data_in_leaf=5, verbosity=-1, num_iterations=n_iter,
+                 snapshot_freq=snapshot_freq, metric_freq=4,
+                 tree_grow_mode="level", trees_per_chunk=2)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
+                                   min_data_in_leaf=cfg.min_data_in_leaf)
+    booster = create_boosting(cfg.boosting, cfg,
+                              ds, create_objective(cfg.objective, cfg))
+    booster.add_train_metrics(create_metrics(cfg.metric, cfg))
+    assert booster.learner.effective_grow_mode() == "level", \
+        "level mode must engage under LIGHTGBM_TPU_PALLAS_INTERPRET"
+    return booster
+"""
+
+_LEVEL_CHILD_SRC = _LEVEL_TRAIN_SRC + r"""
+from lightgbm_tpu import resilience
+resilience.install_preemption_handler()
+booster = build(int(os.environ["TOTAL_ITERS"]), int(os.environ["SNAP_FREQ"]))
+sig_after = int(os.environ["SIG_AFTER_CHUNKS"])
+if sig_after:
+    orig_chunk = booster.train_chunk
+    state = {"n": 0}
+
+    def chunk(k):
+        r = orig_chunk(k)
+        state["n"] += 1
+        if state["n"] == sig_after:
+            signal.raise_signal(signal.SIGTERM)
+        return r
+
+    booster.train_chunk = chunk
+try:
+    booster.train(snapshot_out=os.environ["MODEL_OUT"])
+except resilience.TrainingPreempted as exc:
+    print("PREEMPTED iter=%d" % exc.iteration)
+    sys.exit(resilience.EXIT_PREEMPTED)
+booster.save_model(os.environ["MODEL_OUT"])
+print("TRAINED-TO-END")
+"""
+
+
+def scenario_level_preempt(workdir: str) -> None:
+    """tree_grow_mode=level (+ trees_per_chunk) under the preemption drill:
+    the level-batched dispatch must checkpoint at a chunk boundary and
+    resume bit-exact, proving the round-12 dispatch shape holds the same
+    checkpoint/preemption invariants as the leaf-wise path."""
+    from lightgbm_tpu.resilience import EXIT_PREEMPTED
+    total, sf = 8, 3
+    ref_out = os.path.join(workdir, "level_ref.txt")
+    p = _run_child(_LEVEL_CHILD_SRC, {
+        "MODEL_OUT": ref_out, "TOTAL_ITERS": str(total),
+        "SNAP_FREQ": str(sf), "SIG_AFTER_CHUNKS": "0"})
+    assert "TRAINED-TO-END" in p.stdout, p.stdout + p.stderr[-2000:]
+    with open(ref_out) as fh:
+        ref = fh.read()
+    out = os.path.join(workdir, "level_model.txt")
+    p = _run_child(_LEVEL_CHILD_SRC, {
+        "MODEL_OUT": out, "TOTAL_ITERS": str(total), "SNAP_FREQ": str(sf),
+        "SIG_AFTER_CHUNKS": "1"})
+    assert p.returncode == EXIT_PREEMPTED, \
+        "expected exit %d, got %r: %s" % (EXIT_PREEMPTED, p.returncode,
+                                          p.stdout + p.stderr[-2000:])
+    assert "PREEMPTED" in p.stdout
+    sys.path.insert(0, REPO)
+    os.environ["LIGHTGBM_TPU_PALLAS_INTERPRET"] = "1"
+    try:
+        ns = {}
+        exec(compile(_LEVEL_TRAIN_SRC, "<level-train>", "exec"), ns)
+        booster = ns["build"](total, sf)
+        resumed = booster.resume_from_checkpoint(out)
+        assert 0 < resumed < total, resumed
+        booster.train()
+        got = booster.save_model_to_string()
+    finally:
+        os.environ.pop("LIGHTGBM_TPU_PALLAS_INTERPRET", None)
+    assert got == ref, \
+        "level-mode preempted resume diverged from the uninterrupted run"
+    print("PASS level-preempt: level-batched dispatch preempts at the chunk "
+          "boundary and resumes bit-exact (resumed at iter %d)" % resumed)
+
+
 SCENARIOS = {"kill-write": scenario_kill_write,
+             "level-preempt": scenario_level_preempt,
              "corrupt": scenario_corrupt,
              "nan-grad": scenario_nan_grad,
              "sigterm": scenario_sigterm,
