@@ -549,6 +549,29 @@ class Booster:
         restored iteration, 0 when no usable checkpoint exists."""
         return self._booster.resume_from_checkpoint(checkpoint_prefix)
 
+    # ---- serving (lightgbm_tpu/serving) ----
+
+    def serve(self, name: str = "model", **server_kwargs):
+        """Start a serving tier with this booster resident as ``name``.
+
+        The returned :class:`~lightgbm_tpu.serving.Server` coalesces
+        single-row and micro-batch requests into the fused engine's
+        shape-bucket ladder (``submit``/``predict``), supports per-request
+        ``num_iteration``/``pred_early_stop`` and binned inputs, and can
+        hold more models (``server.register``) or hot-swap this one
+        (``server.swap(name, new_booster)``).  Serving knobs come from this
+        booster's params (``max_batch_wait_us``,
+        ``serve_residency_budget_mb``, ``serve_single_row_fast``);
+        ``server_kwargs`` override per instance."""
+        from .serving import Server
+        server = Server(config=self.config, **server_kwargs)
+        try:
+            server.register(name, self._booster)
+        except BaseException:
+            server.close(drain=False)  # don't leak the dispatcher thread
+            raise
+        return server
+
     # ---- telemetry (lightgbm_tpu/obs) ----
 
     def telemetry_summary(self) -> Optional[Dict]:

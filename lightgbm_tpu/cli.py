@@ -89,6 +89,8 @@ class Application:
             self.convert_model()
         elif task == "refit":
             self.refit()
+        elif task == "serve":
+            self.serve()
         else:
             Log.fatal("Unknown task: %s", task)
 
@@ -232,6 +234,17 @@ class Application:
 
     # ---- task=predict (application.cpp:215-252, predictor.hpp) ----
 
+    @staticmethod
+    def _write_result(path: str, out) -> None:
+        """The LightGBM_predict_result.txt format (predictor.hpp), shared
+        by task=predict and task=serve so their outputs stay comparable."""
+        with open(path, "w") as fh:
+            for row in np.atleast_1d(out):
+                if np.ndim(row) == 0:
+                    fh.write("%g\n" % row)
+                else:
+                    fh.write("\t".join("%g" % v for v in row) + "\n")
+
     def predict(self) -> None:
         cfg = self.config
         if not cfg.input_model:
@@ -254,18 +267,87 @@ class Application:
             else:
                 out = booster.predict(X, raw_score=bool(cfg.predict_raw_score),
                                       num_iteration=num_iter)
-            with open(cfg.output_result, "w") as fh:
-                for row in np.atleast_1d(out):
-                    if np.ndim(row) == 0:
-                        fh.write("%g\n" % row)
-                    else:
-                        fh.write("\t".join("%g" % v for v in row) + "\n")
+            self._write_result(cfg.output_result, out)
             Log.info("Finished prediction, wrote results to %s", cfg.output_result)
             if tele is not None:
                 # per-bucket predict latencies + recompile counts ride the run
                 from . import obs
                 from .obs.report import finalize_run
                 finalize_run(tele, extra={"rows_predicted": int(len(X))})
+                obs.disable()
+        finally:
+            self._disarm_resilience(preempt, own_wd)
+            self._close_telemetry(tele)
+
+    # ---- task=serve (the round-13 serving tier over task=predict data) ----
+
+    def serve(self) -> None:
+        """Score ``data`` THROUGH the serving tier: rows are submitted as
+        individual requests (micro-batches for large files), coalesced by
+        the continuous-batching scheduler into the shape-bucket ladder, and
+        written to ``output_result`` in the task=predict format — a CLI
+        smoke of the whole serving stack whose telemetry run
+        (``telemetry_out=...``) carries the serving SLO block.  Output is
+        bit-identical to ``task=predict`` whenever predict takes the fused
+        device path (>= 512 rows); below that predict's host small-batch
+        path accumulates in f64, so scores agree to f32-rounding only."""
+        import time
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("Need input_model for serve task")
+        if cfg.predict_leaf_index or cfg.predict_contrib:
+            # the serving tier scores only; silently writing a different
+            # output format than task=predict would be a data corruption
+            Log.fatal("task=serve serves scores; predict_leaf_index/"
+                      "predict_contrib are not supported — use task=predict")
+        tele = self._configure_telemetry()
+        preempt, own_wd = self._arm_resilience()
+        t_start = time.perf_counter()
+        try:
+            from .serving import Server
+            booster = GBDT.load_model(cfg.input_model, cfg)
+            loader = DatasetLoader(cfg)
+            X = loader.load_prediction_data(cfg.data)
+            server = Server(config=cfg)
+            try:
+                server.register("model", booster)
+                # single-row requests exercise the coalescer (and the fast
+                # path when serve_single_row_fast=true); very large files
+                # fall back to micro-batches so the replay stays
+                # O(batches) host work
+                step = 1 if len(X) <= 8192 else 256
+                num_iter = int(cfg.num_iteration_predict)
+                futures = [server.submit(
+                    "model", X[lo:lo + step],
+                    raw_score=bool(cfg.predict_raw_score),
+                    num_iteration=num_iter)
+                    for lo in range(0, len(X), step)]
+                outs = [f.result() for f in futures]
+            finally:
+                # a failed register/submit/result must not leak the
+                # dispatcher thread (close is idempotent on the happy path)
+                server.close()
+            stats = server.stats()
+            if stats["dropped"]:
+                Log.fatal("serving replay dropped %d requests",
+                          stats["dropped"])
+            # a header-only prediction file serves zero requests; write the
+            # same empty result task=predict produces
+            out = (np.concatenate([np.atleast_1d(o) for o in outs])
+                   if outs else np.zeros(0))
+            self._write_result(cfg.output_result, out)
+            Log.info("Served %d rows in %d requests / %d batches "
+                     "(single-row fast: %d), wrote results to %s",
+                     len(X), stats["submitted"], stats["batches"],
+                     stats["single_row_fast"], cfg.output_result)
+            if tele is not None:
+                from . import obs
+                from .obs.report import finalize_run
+                finalize_run(tele, extra={
+                    "rows_served": int(len(X)),
+                    "serve_requests": int(stats["submitted"]),
+                    "serve_batches": int(stats["batches"]),
+                    "serve_wall_s": time.perf_counter() - t_start})
                 obs.disable()
         finally:
             self._disarm_resilience(preempt, own_wd)

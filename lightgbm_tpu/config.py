@@ -78,7 +78,8 @@ _BOOSTING_ALIASES = {"gbdt": "gbdt", "gbrt": "gbdt", "dart": "dart", "goss": "go
 _TASK_ALIASES = {"train": "train", "training": "train",
                  "predict": "predict", "prediction": "predict", "test": "predict",
                  "convert_model": "convert_model",
-                 "refit": "refit", "refit_tree": "refit"}
+                 "refit": "refit", "refit_tree": "refit",
+                 "serve": "serve", "serving": "serve"}
 
 _TREE_LEARNER_ALIASES = {"serial": "serial",
                          "feature": "feature", "feature_parallel": "feature",
@@ -263,6 +264,17 @@ class Config:
         if self.tree_grow_mode not in ("leaf", "level"):
             Log.fatal("Unknown tree_grow_mode %s (expected leaf or level)",
                       self.tree_grow_mode)
+        # round-13 serving params: the coalescing window is a LATENCY the
+        # operator adds to every request — a window past one second is
+        # almost certainly a unit mistake (us, not ms/s)
+        if int(self.max_batch_wait_us) > 1_000_000:
+            Log.warning("max_batch_wait_us=%d is over one second; the "
+                        "coalescing window is in MICROseconds",
+                        int(self.max_batch_wait_us))
+        import math
+        if not math.isfinite(float(self.serve_residency_budget_mb)):
+            Log.fatal("serve_residency_budget_mb must be finite (use <= 0 "
+                      "for unlimited residency)")
         if ("io_retry_attempts" in self.raw_params
                 or "io_retry_backoff_s" in self.raw_params):
             # the retry policy guards a process-global primitive

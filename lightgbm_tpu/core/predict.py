@@ -83,6 +83,19 @@ def has_categorical_splits(trees: List[Tree]) -> bool:
     return any(t.num_cat > 0 for t in trees)
 
 
+def floor_thresholds_f32(thr64) -> np.ndarray:
+    """Round f64 thresholds TOWARD -inf in f32 so ``v <= thr32`` equals
+    ``v <= thr`` for every f32 input v.  The single source of the rule:
+    the device stacker below and ``model_codegen.compile_single_row`` (the
+    serving single-row fast path) must ship the SAME thresholds or the
+    fast path's bit-exact contract silently breaks."""
+    thr64 = np.asarray(thr64, dtype=np.float64)
+    t32 = thr64.astype(np.float32)
+    over = t32.astype(np.float64) > thr64
+    t32[over] = np.nextafter(t32[over], np.float32(-np.inf))
+    return t32
+
+
 def stack_ensemble_host(trees: List[Tree]) -> EnsembleArrays:
     """Host: stacked NUMPY arrays for a list of (same-class) trees (the
     tree-blocked stacker pads/reshapes these before the device transfer)."""
@@ -106,12 +119,7 @@ def stack_ensemble_host(trees: List[Tree]) -> EnsembleArrays:
     for i, tree in enumerate(trees):
         ni = max(tree.num_leaves - 1, 0)
         sf[i, :ni] = tree.split_feature[:ni]
-        # round the f64 threshold TOWARD -inf in f32: v <= thr32 is then
-        # exactly v <= thr for every f32 input v
-        t32 = tree.threshold[:ni].astype(np.float32)
-        over = t32.astype(np.float64) > tree.threshold[:ni]
-        t32[over] = np.nextafter(t32[over], -np.inf)
-        thr[i, :ni] = t32
+        thr[i, :ni] = floor_thresholds_f32(tree.threshold[:ni])
         dt = tree.decision_type[:ni].astype(np.int32)
         dl[i, :ni] = (dt & K_DEFAULT_LEFT_MASK) != 0
         mt[i, :ni] = (dt >> 2) & 3

@@ -332,6 +332,13 @@ class FusedPredictor:
                              "layout (bin mappers + EFB groups)")
         self.kind = kind
         self.n_trees = len(trees)
+        # serving attribution: the ModelRegistry stamps the owning model's
+        # name here so degraded-path fallbacks count per model, and hooks
+        # on_fallback so each registry tallies only its OWN degradations
+        # (the process-global resilience ledger can't distinguish two
+        # registries holding a model under the same name)
+        self.owner: Optional[str] = None
+        self.on_fallback = None
         # keep the layout dataset alive: GBDT's predictor cache keys on
         # id(dataset), which must not be recycled while this entry lives
         self.layout_ds = dataset
@@ -444,8 +451,15 @@ class FusedPredictor:
             Log.warning("fused predict failed for bucket %d (%s: %s); "
                         "serving DEGRADED via the per-tree scan path",
                         bucket, type(exc).__name__, exc)
-        note_fallback("predict_blocked", reason="%s: %s"
-                      % (type(exc).__name__, exc), bucket=int(bucket))
+        # serving runs carry the owning model in the site key so fallback
+        # counts surface per model in the registry stats + summary
+        site = ("predict_blocked@%s" % self.owner if self.owner
+                else "predict_blocked")
+        note_fallback(site, reason="%s: %s" % (type(exc).__name__, exc),
+                      bucket=int(bucket),
+                      **({"model": self.owner} if self.owner else {}))
+        if self.on_fallback is not None:
+            self.on_fallback(site)
         out = predict_scan_fallback(
             self._fallback_ens(), rows,
             early_stop_margin=float(early_stop_margin),

@@ -20,7 +20,7 @@ from .config import alias_transform
 from .utils.log import Log
 from .utils.timer import global_timer
 
-__all__ = ["train", "cv", "CVBooster"]
+__all__ = ["train", "cv", "serve", "CVBooster"]
 
 _NUM_BOOST_ROUND_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
                             "n_iter", "num_tree", "num_trees", "num_round",
@@ -299,6 +299,57 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         if own_tele and obs.active() is tele:
             obs.disable()
 
+
+
+def serve(models, params: Optional[Dict[str, Any]] = None, **server_kwargs):
+    """Start a serving tier (lightgbm_tpu/serving) over one or many models.
+
+    ``models`` is a Booster / GBDT / model-file path, or a dict of
+    ``name -> one of those`` for multi-model residency.  ``params`` feeds
+    the serving knobs (``max_batch_wait_us``, ``serve_residency_budget_mb``,
+    ``serve_single_row_fast``, plus ``telemetry_out`` if the caller has not
+    configured a run); extra keyword arguments go to
+    :class:`~lightgbm_tpu.serving.Server` (e.g. ``max_queue_depth``).
+    Returns the running :class:`~lightgbm_tpu.serving.Server` — submit with
+    ``server.submit(name, rows)`` / ``server.predict``, republish with
+    ``server.swap``, and ``server.close()`` when done (also a context
+    manager)."""
+    from .config import Config
+    from .serving import Server
+
+    cfg = Config(alias_transform(dict(params or {})))
+    t_out = str(getattr(cfg, "telemetry_out", "") or "")
+    own_tele = None
+    if t_out and obs.active() is None:
+        own_tele = obs.configure(out=t_out,
+                                 freq=int(getattr(cfg, "telemetry_freq", 1)),
+                                 entry="engine.serve")
+    server = None
+    try:
+        # the run stays open for telemetry_summary() reads while serving;
+        # server.close() finalizes it into <telemetry_out>.summary.json and
+        # releases the process-active slot (same ownership rule as
+        # engine.train)
+        server = Server(config=cfg, owned_telemetry=own_tele,
+                        **server_kwargs)
+        if not isinstance(models, dict):
+            models = {"model": models}
+        for name, model in models.items():
+            if isinstance(model, str):
+                from .boosting.gbdt import GBDT
+                model = GBDT.load_model(model, cfg)
+            server.register(name, model)
+    except BaseException:
+        # a failed construction/load/register must not leak the dispatcher
+        # thread or hold the process-active telemetry slot hostage (no
+        # summary is finalized for a run that never served)
+        if server is not None:
+            server.disown_telemetry()
+            server.close(drain=False)
+        if own_tele is not None and obs.active() is own_tele:
+            obs.disable()
+        raise
+    return server
 
 
 class CVBooster:

@@ -21,6 +21,14 @@ Layout::
                      "predict_fallbacks": n, "checkpoint_skipped": n,
                      "preempt_checkpoint_s": {histogram summary},
                      "watchdog_stall_s": x|null},
+      "serving": {"models": {name: {"requests": n, "rows": n, "qps": x|null,
+                                    "latency_s": {histogram summary},
+                                    "occupancy": {histogram summary},
+                                    "fallbacks": n}},
+                  "batches": n, "single_row_fast": n, "rejected": n,
+                  "evictions": n, "swaps": n, "readmits": n,
+                  "queue_depth": {histogram summary},
+                  "wall_s": x|null},             # only when the run served
       "mfu": x|null, "device_util": y|null,
       "events": <event count>
     }
@@ -32,6 +40,58 @@ from typing import Any, Dict, Optional
 
 from . import launches, recompile
 from .registry import EVENT_SCHEMA_VERSION, Telemetry
+
+_SERVE_REQ = "serve_requests_model_"
+_SERVE_ROWS = "serve_rows_model_"
+_SERVE_LAT = "serve_latency_s_model_"
+_SERVE_OCC = "serve_occupancy_model_"
+_SERVE_FB = "predict_fallbacks_model_"
+
+
+def serving_block(counters: Dict[str, Any], gauges: Dict[str, Any],
+                  hists: Dict[str, Any]):
+    """Fold the serving tier's per-model metrics into one summary section
+    (None when the run never served).  Shared by :func:`summarize` and
+    ``tools/obs_report.py``'s died-run recovery path."""
+    models: Dict[str, Dict[str, Any]] = {}
+
+    def m(name):
+        return models.setdefault(name, {})
+
+    for name, n in counters.items():
+        if name.startswith(_SERVE_REQ):
+            m(name[len(_SERVE_REQ):])["requests"] = int(n)
+        elif name.startswith(_SERVE_ROWS):
+            m(name[len(_SERVE_ROWS):])["rows"] = int(n)
+        elif name.startswith(_SERVE_FB):
+            m(name[len(_SERVE_FB):])["fallbacks"] = int(n)
+    for name, h in hists.items():
+        if name.startswith(_SERVE_LAT):
+            m(name[len(_SERVE_LAT):])["latency_s"] = h
+        elif name.startswith(_SERVE_OCC):
+            m(name[len(_SERVE_OCC):])["occupancy"] = h
+    if not models and not counters.get("serve_batches") \
+            and not counters.get("serve_rejected") \
+            and not counters.get("serve_failed"):
+        # rejected/failed-only runs still get a block: a fully saturated
+        # deployment is exactly when the backpressure counters matter
+        return None
+    wall = gauges.get("serve_wall_s")
+    for info in models.values():
+        req = info.get("requests")
+        info["qps"] = (req / wall) if (req and wall) else None
+    return {
+        "models": models,
+        "batches": int(counters.get("serve_batches", 0)),
+        "single_row_fast": int(counters.get("serve_single_row_fast", 0)),
+        "rejected": int(counters.get("serve_rejected", 0)),
+        "failed": int(counters.get("serve_failed", 0)),
+        "evictions": int(counters.get("serve_evictions", 0)),
+        "swaps": int(counters.get("serve_swaps", 0)),
+        "readmits": int(counters.get("serve_readmits", 0)),
+        "queue_depth": hists.get("serve_queue_depth", {"count": 0}),
+        "wall_s": wall,
+    }
 
 
 def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
@@ -118,6 +178,11 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
         "device_util": gauges.get("device_util"),
         "events": getattr(tele, "event_count", len(tele.events)),
     }
+    # serving rollup (lightgbm_tpu/serving): per-model qps/latency/occupancy
+    # plus eviction/swap counts — present only when the run served traffic
+    serving = serving_block(counters, gauges, hists)
+    if serving is not None:
+        out["serving"] = serving
     if extra:
         out.update(extra)
     return out
@@ -152,6 +217,32 @@ def human_table(summary: Dict[str, Any]) -> str:
                 "%d over %d trees (%s/tree)"
                 % (d.get("launches", 0), d.get("trees", 0),
                    "-" if per is None else "%.1f" % per))
+    srv = summary.get("serving") or {}
+    if srv:
+        lines.append("  serving:")
+        for name, info in sorted((srv.get("models") or {}).items()):
+            lat = info.get("latency_s") or {}
+            occ = info.get("occupancy") or {}
+            row("    model %s" % name,
+                "req=%d rows=%d qps=%s p50=%s p99=%s occ=%s fb=%d"
+                % (info.get("requests", 0), info.get("rows", 0),
+                   "-" if info.get("qps") is None else "%.1f" % info["qps"],
+                   "-" if not lat.get("count") else "%.6g" % lat["p50"],
+                   "-" if not lat.get("count") else "%.6g" % lat["p99"],
+                   "-" if not occ.get("count") else "%.2f" % occ["p50"],
+                   info.get("fallbacks", 0)))
+        row("    batches", "%d (single-row fast %d)"
+            % (srv.get("batches", 0), srv.get("single_row_fast", 0)))
+        qd = srv.get("queue_depth") or {}
+        if qd.get("count"):
+            row("    queue depth", "p50=%.6g p99=%.6g"
+                % (qd.get("p50", float("nan")), qd.get("p99", float("nan"))))
+        row("    evictions/swaps/readmits", "%d/%d/%d"
+            % (srv.get("evictions", 0), srv.get("swaps", 0),
+               srv.get("readmits", 0)))
+        if srv.get("rejected") or srv.get("failed"):
+            row("    rejected/failed", "%d/%d"
+                % (srv.get("rejected", 0), srv.get("failed", 0)))
     res = summary.get("resilience") or {}
     shown = {k: v for k, v in sorted(res.items())
              if (isinstance(v, (int, float)) and v)

@@ -421,13 +421,19 @@ _FALLBACKS: Dict[str, int] = {}
 def note_fallback(site: str, reason: str = "", **fields: Any) -> None:
     """Count one degraded-path activation at ``site``; mirrored to the
     active telemetry run (counter ``predict_fallbacks`` + a
-    ``predict_fallback`` event) when one is configured."""
+    ``predict_fallback`` event) when one is configured.  A ``model=<name>``
+    field (the serving tier's per-model attribution) additionally bumps a
+    ``predict_fallbacks_model_<name>`` counter so the summary's serving
+    block can surface fallbacks per resident model."""
     with _FB_LOCK:
         _FALLBACKS[site] = _FALLBACKS.get(site, 0) + 1
     from .obs import active as _telemetry_active
     tele = _telemetry_active()
     if tele is not None:
         tele.counter("predict_fallbacks").inc()
+        model = fields.get("model")
+        if model:
+            tele.counter("predict_fallbacks_model_%s" % model).inc()
         tele.event("predict_fallback", site=site, reason=str(reason)[:300],
                    **fields)
 

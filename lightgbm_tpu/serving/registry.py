@@ -1,0 +1,606 @@
+"""Multi-model residency: many boosters resident under one memory budget.
+
+The serving counterpart of the reference's per-handle predictor cache
+(c_api.cpp:52-98 ``SingleRowPredictor``) scaled to a fleet: a
+:class:`ModelRegistry` keeps many models' stacked device ensembles
+(:class:`~..core.predict_fused.FusedPredictor`) resident at once, bounded by
+a configurable HBM/host-memory budget — the same host-static sizing
+discipline as ``partition.fused_bucket_plan`` / ``predict_fused.tree_block``:
+a resident model's footprint is derived purely from its ensemble shape
+(``sum(field.size * itemsize)`` over the stacked arrays), so admission and
+eviction decisions never touch the device.
+
+Residency rules:
+
+- **LRU under a budget**: admission evicts least-recently-used residents
+  until the newcomer fits.  An evicted model keeps its host trees parked
+  (cheap) and is re-admitted transparently on the next request — the
+  re-stacked arrays have the same shapes/dtypes, so ``predict_blocked``'s
+  jit cache is hit and re-admission recompiles at most once per bucket
+  (zero when the bucket was ever compiled for that shape).
+- **in-flight models never tear**: every dispatch holds a refcount
+  (:meth:`ModelRegistry.acquire` / :meth:`~ModelRegistry.release`); an
+  eviction or swap that hits a model mid-dispatch only MARKS it — the
+  arrays are dropped when the last in-flight batch releases.
+- **atomic hot-swap** (:meth:`ModelRegistry.swap`): the replacement is
+  stacked (and optionally bucket-warmed) BEFORE the name flips, so new
+  arrivals route to the new ensemble with no recompile stall, in-flight
+  requests finish on the old one, and the old predictor entry is dropped
+  once its refcount drains.  No request is ever dropped or served a torn
+  model.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.predict_fused import PREDICT_BUCKETS, FusedPredictor
+from ..obs import active as _telemetry_active
+from ..utils.log import LightGBMError, Log
+
+DEFAULT_BUDGET_MB = 1024.0
+
+
+def _safe_name(name: str) -> str:
+    """Model name -> metric-name-safe token."""
+    return re.sub(r"[^0-9A-Za-z_.-]", "_", str(name))
+
+
+def _ens_bytes(ens) -> int:
+    """Host-static footprint of a stacked ensemble (every field, bytes)."""
+    return int(sum(a.size * a.dtype.itemsize for a in ens))
+
+
+def _unwrap(booster):
+    """Accept a boosting.GBDT or a basic.Booster; return the GBDT."""
+    inner = getattr(booster, "_booster", None)
+    return inner if inner is not None else booster
+
+
+def early_stop_allowed(gbdt) -> bool:
+    """Whether margin-based prediction early stop is sound for this model —
+    the gate ``GBDT._predict_early_stop`` applies to the CONFIG flag,
+    applied here to explicit per-request ``pred_early_stop=True`` too."""
+    return (max(int(gbdt.num_tree_per_iteration), 1) == 1
+            and gbdt.objective is not None
+            and not gbdt.objective.need_accurate_prediction)
+
+
+class ResidentModel:
+    """One resident model: its booster plus the cached FusedPredictors.
+
+    Predictors are keyed by (kind, start_iter, end_iter, class) — the same
+    key space as ``GBDT._fused_predictor`` — built on first use and owned
+    here so eviction/swap can drop exactly this model's device arrays.
+    ``inflight`` counts dispatches holding the entry; ``retired`` /
+    ``evict_pending`` defer the drop until the count drains."""
+
+    def __init__(self, name: str, booster, layout_ds=None,
+                 registry: Optional["ModelRegistry"] = None) -> None:
+        self.name = str(name)
+        self.gbdt = _unwrap(booster)
+        self.layout_ds = (layout_ds if layout_ds is not None
+                          else getattr(self.gbdt, "train_data", None))
+        self.K = max(int(self.gbdt.num_tree_per_iteration), 1)
+        self.total_iter = len(self.gbdt.models) // self.K
+        # booster-config early-stop defaults (margin, freq); per-request
+        # overrides replace them at submit time
+        self.default_early_stop: Tuple[float, int] = \
+            self.gbdt._predict_early_stop()
+        # the engine's gate for EXPLICIT pred_early_stop=True requests:
+        # margin-based truncation is only sound for single-output models
+        # whose objective tolerates inaccurate raw scores
+        # (predictor.hpp:38-47 NeedAccuratePrediction)
+        self.early_stop_allowed = early_stop_allowed(self.gbdt)
+        self._registry = registry
+        self._preds: Dict[Tuple[str, int, int, int], FusedPredictor] = {}
+        self._single: Dict[Tuple[int, int], Any] = {}
+        self.inflight = 0
+        self.retired = False
+        self.evict_pending = False
+        # stack the primary (full-range raw) predictors eagerly: they ARE
+        # the admission-time footprint estimate.  resident_bytes is the
+        # TRUE footprint; accounted_bytes is what the registry has counted
+        # against its budget (admission + counted growth) — drop() gives
+        # back exactly the accounted amount, so growth on an
+        # already-retired entry can never underflow the budget ledger
+        self.resident_bytes = 0
+        self.accounted_bytes = 0
+        for k in range(self.K):
+            self._predictor("raw", 0, self.total_iter, k)
+
+    @property
+    def supports_binned(self) -> bool:
+        return self.layout_ds is not None
+
+    def _predictor(self, kind: str, start: int, end: int,
+                   k: int) -> FusedPredictor:
+        key = (kind, start, end, k)
+        pred = self._preds.get(key)
+        if pred is None:
+            sel = self.gbdt.models[start * self.K:end * self.K][k::self.K]
+            pred = FusedPredictor(
+                sel, dataset=self.layout_ds if kind == "binned" else None,
+                kind=kind)
+            # per-model attribution for degraded-serving fallback counts —
+            # the metric-safe token, so the fallback counter joins the same
+            # serving-block model entry as every other serve_* metric
+            pred.owner = _safe_name(self.name)
+            if self._registry is not None:
+                pred.on_fallback = self._registry._note_fallback
+            self._preds[key] = pred
+            grew = _ens_bytes(pred.ens) if pred.ens is not None else 0
+            self.resident_bytes += grew
+            if self._registry is not None and grew:
+                self._registry._note_growth(self, grew)
+        return pred
+
+    def _resolve_range(self, num_iteration: int,
+                       start_iteration: int) -> Tuple[int, int]:
+        end = (self.total_iter if num_iteration <= 0
+               else min(self.total_iter, start_iteration + num_iteration))
+        return int(start_iteration), int(end)
+
+    def _transform(self, raw: np.ndarray, raw_score: bool) -> np.ndarray:
+        """Exactly ``GBDT.predict``'s epilogue: average_output divides by
+        the TOTAL trained iteration count, then the objective transform."""
+        g = self.gbdt
+        if g.average_output:
+            raw = raw / max(len(g.models) // self.K, 1)
+        if not raw_score and g.objective is not None:
+            raw = np.asarray(g.objective.convert_output(raw))
+        return raw[0] if self.K == 1 else raw.T
+
+    def predict(self, rows: np.ndarray, kind: str = "raw",
+                num_iteration: int = -1, start_iteration: int = 0,
+                margin: float = -1.0, freq: int = 10,
+                raw_score: bool = False) -> np.ndarray:
+        """Batched predict through the cached FusedPredictor(s) — always
+        the fused bucketed path (never the host fallback), so the
+        steady-state no-recompile gauge covers every serving dispatch."""
+        start, end = self._resolve_range(num_iteration, start_iteration)
+        raw = np.zeros((self.K, len(rows)), dtype=np.float64)
+        for k in range(self.K):
+            raw[k] = self._predictor(kind, start, end, k)(
+                rows, early_stop_margin=float(margin),
+                round_period=int(freq))
+        return self._transform(raw, raw_score)
+
+    def predict_single(self, row: np.ndarray, num_iteration: int = -1,
+                       start_iteration: int = 0,
+                       raw_score: bool = False) -> np.ndarray:
+        """Batch-size-1 fast path: the compiled if/else chain from
+        ``model_codegen.compile_single_row`` (the reference's
+        ``Tree::ToIfElse`` idea) — no device dispatch, no padding, bit-exact
+        vs ``predict_blocked`` on the same row."""
+        start, end = self._resolve_range(num_iteration, start_iteration)
+        fn = self._single.get((start, end))
+        if fn is None:
+            if len(self._single) >= 8:
+                # per-request num_iteration sweeps must not grow compiled
+                # chains unboundedly (same cap idiom as GBDT._fused_pred)
+                self._single.pop(next(iter(self._single)))
+            from ..model_codegen import compile_single_row
+            fn = compile_single_row(self.gbdt, start_iteration=start,
+                                    num_iteration=end - start)
+            self._single[(start, end)] = fn
+        raw = fn(row).reshape(self.K, 1)
+        return self._transform(raw, raw_score)
+
+    def warm(self, buckets=(PREDICT_BUCKETS[0],)) -> None:
+        """Pre-dispatch one zero batch per bucket so the first real request
+        after an admission/swap never waits on a compile (a cache hit when
+        the shapes were ever compiled — the no-recompile-stall swap)."""
+        n_feat = int(self.gbdt.max_feature_idx) + 1
+        for b in buckets:
+            self.predict(np.zeros((int(b), n_feat), dtype=np.float32),
+                         raw_score=True)
+
+    def drop(self) -> int:
+        """Release the device arrays; returns the bytes the registry had
+        ACCOUNTED for this entry (what its ledger must give back)."""
+        freed = self.accounted_bytes
+        self.resident_bytes = 0
+        self.accounted_bytes = 0
+        self._preds.clear()
+        self._single.clear()
+        return freed
+
+
+class ModelRegistry:
+    """Name -> :class:`ResidentModel` with LRU eviction under a budget.
+
+    ``budget_mb <= 0`` means unlimited.  All mutation happens under one
+    re-entrant lock; predictor STACKING for register/swap happens before
+    the lock is taken (the flip itself is a dict assignment — atomic
+    republish), so traffic on other models never stalls behind a build."""
+
+    def __init__(self, budget_mb: float = DEFAULT_BUDGET_MB) -> None:
+        self.budget_bytes = (int(float(budget_mb) * (1 << 20))
+                             if float(budget_mb) > 0 else 0)
+        self._lock = threading.RLock()
+        # signaled when a re-admission build finishes (see acquire)
+        self._changed = threading.Condition(self._lock)
+        self._resident: "OrderedDict[str, ResidentModel]" = OrderedDict()
+        # evicted models park their host booster (+ layout) here so the
+        # next acquire re-admits transparently
+        self._parked: Dict[str, Tuple[Any, Any]] = {}
+        # re-admissions mid-build: name -> (gbdt, layout).  Stacking runs
+        # OUTSIDE the lock; these entries keep the name known meanwhile
+        self._building: Dict[str, Tuple[Any, Any]] = {}
+        self._bytes = 0
+        self.evictions = 0
+        self.swaps = 0
+        self.readmits = 0
+        # degraded-serving tally owned by THIS registry: its predictors
+        # call back here on fallback, so stats() never attributes another
+        # registry's degradations (the process-global resilience ledger is
+        # site-keyed and two registries may hold the same model name)
+        self._fallbacks: Dict[str, int] = {}
+
+    def _note_fallback(self, site: str) -> None:
+        with self._lock:
+            self._fallbacks[site] = self._fallbacks.get(site, 0) + 1
+
+    # ---- admission / eviction ----
+
+    def _evict_for(self, needed: int, keep: Optional[str] = None) -> None:
+        """Under the lock: mark/evict LRU residents until ``needed`` fits.
+        Models mid-dispatch are only MARKED (``evict_pending``) — their
+        arrays drop at the final :meth:`release`, so the budget can
+        transiently overshoot rather than ever tearing an in-flight
+        ensemble."""
+        if not self.budget_bytes:
+            return
+        for name in list(self._resident):
+            if self._bytes + needed <= self.budget_bytes:
+                break
+            if name == keep:
+                continue
+            entry = self._resident[name]
+            if entry.inflight > 0:
+                entry.evict_pending = True
+                continue
+            self._finalize_evict(name, entry)
+
+    def _finalize_evict(self, name: str, entry: ResidentModel) -> None:
+        del self._resident[name]
+        self._parked[name] = (entry.gbdt, entry.layout_ds)
+        self._bytes -= entry.drop()
+        entry.retired = True
+        self.evictions += 1
+        Log.debug("serving: evicted model %r (LRU, budget)", name)
+        tele = _telemetry_active()
+        if tele is not None:
+            tele.counter("serve_evictions").inc()
+            tele.event("serve_evict", model=_safe_name(name))
+
+    def _admit_locked(self, entry: ResidentModel) -> None:
+        """Under the lock: evict to fit, publish, account."""
+        self._evict_for(entry.resident_bytes, keep=entry.name)
+        self._resident[entry.name] = entry
+        self._resident.move_to_end(entry.name)
+        self._bytes += entry.resident_bytes
+        entry.accounted_bytes = entry.resident_bytes
+        tele = _telemetry_active()
+        if tele is not None:
+            tele.gauge("serve_resident_models").set(len(self._resident))
+            tele.gauge("serve_resident_bytes").set(self._bytes)
+
+    def _note_growth(self, entry: ResidentModel, grew: int) -> None:
+        """A resident built a new predictor range: account it and rebalance
+        (never evicting the grower itself).  Growth during the entry's own
+        CONSTRUCTION is not counted here — admission adds the finished
+        ``resident_bytes`` exactly once."""
+        with self._lock:
+            if entry.retired or self._resident.get(entry.name) is not entry:
+                return
+            self._bytes += grew
+            entry.accounted_bytes += grew
+            self._evict_for(0, keep=entry.name)
+
+    # ---- public surface ----
+
+    def register(self, name: str, booster, layout_ds=None) -> ResidentModel:
+        """Stack and admit a new model; duplicate names must use
+        :meth:`swap` (an explicit republish, never a silent overwrite).
+        The name is RESERVED (via the building table) before the stacking
+        starts, so two concurrent registers of one name cannot both admit
+        — the loser errors, it does not silently overwrite."""
+        name = str(name)
+        with self._lock:
+            if name in self._resident or name in self._parked \
+                    or name in self._building:
+                raise LightGBMError(
+                    "model %r is already registered; use swap() to "
+                    "republish it" % name)
+            self._building[name] = (_unwrap(booster), layout_ds)
+        try:
+            entry = ResidentModel(name, booster, layout_ds=layout_ds,
+                                  registry=self)
+        except BaseException:
+            with self._changed:
+                self._building.pop(name, None)
+                self._changed.notify_all()
+            raise
+        with self._changed:
+            if self._building.pop(name, None) is None:
+                # unregistered mid-build
+                entry.retired = True
+                entry.drop()
+                self._changed.notify_all()
+                raise LightGBMError("model %r was unregistered during its "
+                                    "registration" % name)
+            # publish under the SAME lock acquisition as the building-pop:
+            # a waiter (swap/unregister) woken between the two could
+            # otherwise interleave and be clobbered by this admit
+            self._admit_locked(entry)
+            self._changed.notify_all()
+        return entry
+
+    def swap(self, name: str, booster, layout_ds=None,
+             warm=True) -> ResidentModel:
+        """Atomically republish ``name``: the replacement is fully stacked
+        (and bucket-warmed unless ``warm=False``) BEFORE the flip; in-flight
+        requests finish on the old ensemble, new arrivals route to the new
+        one, and the old predictor entries drop when their refcount drains.
+        ``warm`` may be True (smallest bucket), an iterable of bucket
+        sizes, or False."""
+        name = str(name)
+        with self._lock:
+            if name not in self._resident and name not in self._parked \
+                    and name not in self._building:
+                raise LightGBMError("cannot swap unknown model %r (register "
+                                    "it first)" % name)
+        entry = ResidentModel(name, booster, layout_ds=layout_ds,
+                              registry=self)
+        if warm:
+            entry.warm((PREDICT_BUCKETS[0],) if warm is True
+                       else tuple(int(b) for b in warm))
+        with self._changed:
+            # a racing re-admission build finishes first: the swap retires
+            # whatever generation it published
+            while name in self._building:
+                self._changed.wait()
+            if name not in self._resident and name not in self._parked:
+                # unregistered while the replacement was stacking: admitting
+                # now would resurrect a name the caller already removed
+                # (register/acquire defend the same interleaving)
+                entry.retired = True
+                entry.drop()
+                raise LightGBMError("model %r was unregistered during its "
+                                    "swap" % name)
+            old = self._resident.pop(name, None)
+            self._parked.pop(name, None)
+            if old is not None:
+                # retire the outgoing generation BEFORE sizing the
+                # admission: a drained old entry gives its bytes back now,
+                # so a same-size swap under a tight budget does not evict
+                # innocent co-residents (an in-flight old keeps its bytes
+                # counted — its arrays really are still live)
+                old.retired = True
+                if old.inflight == 0:
+                    self._bytes -= old.drop()
+            self._admit_locked(entry)
+            self.swaps += 1
+            tele = _telemetry_active()
+            if tele is not None:
+                tele.counter("serve_swaps").inc()
+                tele.event("serve_swap", model=_safe_name(name),
+                           deferred=bool(old is not None
+                                         and old.inflight > 0))
+        return entry
+
+    def unregister(self, name: str) -> None:
+        with self._changed:
+            entry = self._resident.pop(str(name), None)
+            self._parked.pop(str(name), None)
+            self._building.pop(str(name), None)
+            self._changed.notify_all()
+            if entry is not None:
+                entry.retired = True
+                if entry.inflight == 0:
+                    self._bytes -= entry.drop()
+
+    def knows(self, name: str) -> bool:
+        with self._lock:
+            return (str(name) in self._resident
+                    or str(name) in self._parked
+                    or str(name) in self._building)
+
+    def supports_binned(self, name: str) -> bool:
+        with self._lock:
+            entry = self._resident.get(str(name))
+            if entry is not None:
+                return entry.supports_binned
+            parked = (self._parked.get(str(name))
+                      or self._building.get(str(name)))
+            if parked is None:
+                raise LightGBMError("unknown model %r" % name)
+            gbdt, layout = parked
+            return (layout if layout is not None
+                    else getattr(gbdt, "train_data", None)) is not None
+
+    def acquire(self, name: str) -> ResidentModel:
+        """Pin a model for one dispatch (LRU-touches it; transparently
+        re-admits a parked model).  Re-stacking runs OUTSIDE the registry
+        lock — the same build-then-flip discipline as register/swap — so
+        submits and registry calls for OTHER models never block on the
+        lock; a second acquirer of the same parked name waits for the
+        first build instead of duplicating it.  (The build still occupies
+        the CALLING thread — under the single-dispatcher scheduler a
+        re-admission delays the queue for its duration, which is the cost
+        of transparent re-admission; size the residency budget so hot
+        models stay resident.)  Pair with :meth:`release`."""
+        name = str(name)
+        with self._changed:
+            while True:
+                entry = self._resident.get(name)
+                if entry is not None:
+                    self._resident.move_to_end(name)
+                    entry.inflight += 1
+                    return entry
+                if name in self._building:
+                    self._changed.wait()
+                    continue
+                parked = self._parked.pop(name, None)
+                if parked is None:
+                    raise LightGBMError("unknown model %r" % name)
+                self._building[name] = parked
+                break
+        try:
+            entry = ResidentModel(name, parked[0], layout_ds=parked[1],
+                                  registry=self)
+        except BaseException:
+            with self._changed:
+                if self._building.pop(name, None) is not None:
+                    # re-park only while the reservation is still ours — a
+                    # concurrent unregister() removed the name, and
+                    # re-parking would resurrect it (the success path's
+                    # zombie check, mirrored)
+                    self._parked[name] = parked
+                self._changed.notify_all()
+            raise
+        with self._changed:
+            if self._building.pop(name, None) is None:
+                # unregistered mid-build: never publish a zombie
+                entry.retired = True
+                entry.drop()
+                self._changed.notify_all()
+                raise LightGBMError("unknown model %r" % name)
+            self._admit_locked(entry)
+            self.readmits += 1
+            entry.inflight += 1
+            self._changed.notify_all()
+            tele = _telemetry_active()
+            if tele is not None:
+                tele.counter("serve_readmits").inc()
+                tele.event("serve_readmit", model=_safe_name(name))
+            return entry
+
+    def release(self, entry: ResidentModel) -> None:
+        with self._lock:
+            entry.inflight -= 1
+            if entry.inflight == 0:
+                if entry.retired:
+                    # swapped-out / unregistered: drop now that the last
+                    # in-flight batch finished on it
+                    self._bytes -= entry.drop()
+                elif entry.evict_pending:
+                    # the mark was set under budget pressure at admission
+                    # time; only follow through if the registry is STILL
+                    # over budget — other evictions may have resolved it,
+                    # and this entry just proved itself hot
+                    entry.evict_pending = False
+                    if self._resident.get(entry.name) is entry \
+                            and self.budget_bytes \
+                            and self._bytes > self.budget_bytes:
+                        self._finalize_evict(entry.name, entry)
+
+    def resident_names(self) -> List[str]:
+        with self._lock:
+            return list(self._resident)
+
+    def intake_info(self, name: str, binned: bool = False
+                    ) -> Tuple[Optional[int], Tuple[float, int], bool]:
+        """Everything ``Server.submit`` validates, under ONE lock
+        acquisition: (request width or None when not determinable,
+        config-default ``(margin, freq)``, explicit-early-stop-allowed).
+        Raises for unknown names and for binned requests on a model
+        without a layout dataset — the submit hot path pays one registry
+        round-trip, not four."""
+        name = str(name)
+        with self._lock:
+            entry = self._resident.get(name)
+            if entry is not None:
+                gbdt, layout = entry.gbdt, entry.layout_ds
+                defaults = entry.default_early_stop
+                allowed = entry.early_stop_allowed
+            else:
+                parked = (self._parked.get(name)
+                          or self._building.get(name))
+                if parked is None:
+                    raise LightGBMError("unknown model %r" % name)
+                gbdt, layout = parked
+                defaults = gbdt._predict_early_stop()
+                allowed = early_stop_allowed(gbdt)
+        if layout is None:
+            layout = getattr(gbdt, "train_data", None)
+        if binned:
+            if layout is None:
+                raise LightGBMError(
+                    "model %r was registered without a binned layout "
+                    "dataset; binned requests need one" % name)
+            store = getattr(layout, "binned", None)
+            width = int(store.shape[1]) if store is not None else None
+        else:
+            width = int(gbdt.max_feature_idx) + 1
+        return width, defaults, allowed
+
+    def request_width(self, name: str, binned: bool = False
+                      ) -> Optional[int]:
+        """Columns a request for ``name`` must carry — the trained feature
+        count for raw rows, the bin-group row-store width for binned —
+        wherever the model lives.  None when unknown (unknown name, or a
+        binned layout without its row store): the caller skips the check
+        and the dispatch path errors instead."""
+        name = str(name)
+        with self._lock:
+            entry = self._resident.get(name)
+            if entry is not None:
+                gbdt, layout = entry.gbdt, entry.layout_ds
+            else:
+                parked = self._parked.get(name) or self._building.get(name)
+                if parked is None:
+                    return None
+                gbdt, layout = parked
+        if not binned:
+            return int(gbdt.max_feature_idx) + 1
+        if layout is None:
+            layout = getattr(gbdt, "train_data", None)
+        store = getattr(layout, "binned", None) if layout is not None \
+            else None
+        return int(store.shape[1]) if store is not None else None
+
+    def early_stop_defaults(self, name: str) -> Tuple[Tuple[float, int],
+                                                      bool]:
+        """(config-default ``(margin, freq)``, explicit-early-stop-allowed)
+        for a model wherever it lives — resident, parked, or mid-build —
+        so eviction never changes request semantics.  Unknown names get
+        (off, not-allowed); the submit path re-checks :meth:`knows`."""
+        name = str(name)
+        with self._lock:
+            entry = self._resident.get(name)
+            if entry is not None:
+                return entry.default_early_stop, entry.early_stop_allowed
+            parked = self._parked.get(name) or self._building.get(name)
+        if parked is None:
+            return (-1.0, 10), False
+        return parked[0]._predict_early_stop(), early_stop_allowed(parked[0])
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            per_model = {n: {"bytes": e.resident_bytes,
+                             "inflight": e.inflight,
+                             "evict_pending": e.evict_pending}
+                         for n, e in self._resident.items()}
+            out = {
+                "resident": list(self._resident),
+                "parked": sorted(self._parked),
+                "bytes": self._bytes,
+                "budget_bytes": self.budget_bytes,
+                "evictions": self.evictions,
+                "swaps": self.swaps,
+                "readmits": self.readmits,
+                "models": per_model,
+            }
+            # degraded-serving attribution: this registry's own predictors
+            # tallied here via on_fallback, site-keyed
+            # ("predict_blocked@<model>") like the resilience ledger
+            if self._fallbacks:
+                out["fallbacks"] = dict(self._fallbacks)
+        return out

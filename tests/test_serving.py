@@ -1,0 +1,878 @@
+"""Serving tier (lightgbm_tpu/serving): continuous batching, multi-model
+residency, SLO telemetry.
+
+Every serving path is pinned BIT-exact against ``predict_blocked`` (the
+fused engine tests/test_predict_fused.py already pins against the per-tree
+scan): coalesced micro-batches, per-request ``num_iteration`` /
+``pred_early_stop``, binned inputs, and the compiled single-row fast path
+(``model_codegen.compile_single_row``).  Residency edge cases — LRU
+eviction deferring past in-flight dispatches, transparent re-admission
+recompiling at most once per bucket, atomic hot-swap — are pinned via the
+always-on recompile gauge and the registry's refcount state.  Telemetry
+holds PR 5's spy discipline: a serving loop with no run configured makes
+zero telemetry calls.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.predict_fused import FusedPredictor
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.model_codegen import compile_single_row
+from lightgbm_tpu.obs import recompile
+from lightgbm_tpu.objective import create_objective
+from lightgbm_tpu.serving import (ModelRegistry, Server, ServingClosed,
+                                  ServingQueueFull)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _train(seed=0, n=800, objective="regression", num_leaves=8, iters=10,
+           num_class=1, nan_frac=0.0, **extra):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2, 2, size=(n, 6)).astype(np.float32)
+    if nan_frac:
+        X[rng.uniform(size=X.shape) < nan_frac] = np.nan
+    base = np.nan_to_num(X[:, 0]) * 2 + np.sin(np.nan_to_num(X[:, 1]) * 2)
+    if objective == "binary":
+        y = (base + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+    elif objective in ("multiclass", "multiclassova"):
+        y = np.clip(np.digitize(base, [-1.0, 1.0]), 0,
+                    num_class - 1).astype(np.float64)
+    else:
+        y = (base + 0.1 * rng.normal(size=n)).astype(np.float64)
+    cfg = Config(objective=objective, num_leaves=num_leaves,
+                 min_data_in_leaf=5, verbosity=-1, num_iterations=iters,
+                 num_class=num_class, **extra)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
+                                   min_data_in_leaf=cfg.min_data_in_leaf)
+    b = GBDT(cfg, ds, create_objective(cfg.objective, cfg))
+    for _ in range(iters):
+        b.train_one_iter()
+    return b, X
+
+
+@pytest.fixture(scope="module")
+def models():
+    """Two same-shape regression boosters (+ a replacement for swap tests)
+    and a binary NaN-routing booster."""
+    bA, XA = _train(seed=0)
+    bB, XB = _train(seed=1)
+    bB2, _ = _train(seed=2)
+    fb, fb2 = FusedPredictor(bB.models), FusedPredictor(bB2.models)
+    assert [a.shape for a in fb.ens] == [a.shape for a in fb2.ens], \
+        "swap premise: replacement must stack to the same shapes"
+    bbin, Xbin = _train(seed=3, objective="binary", num_leaves=15, iters=12,
+                        nan_frac=0.05)
+    return {"a": (bA, XA), "b": (bB, XB), "b2": (bB2, XB),
+            "bin": (bbin, Xbin)}
+
+
+def _raw_ref(b, X, margin=-1.0, freq=10, num_iteration=-1,
+             start_iteration=0):
+    """The serving bit-exactness reference: predict_blocked through a fresh
+    FusedPredictor over the same model range."""
+    K = max(b.num_tree_per_iteration, 1)
+    total = len(b.models) // K
+    end = total if num_iteration <= 0 else min(total,
+                                               start_iteration + num_iteration)
+    sel = b.models[start_iteration * K:end * K]
+    out = np.zeros((K, len(X)))
+    for k in range(K):
+        out[k] = FusedPredictor(sel[k::K])(X, early_stop_margin=margin,
+                                           round_period=freq)
+    return out[0] if K == 1 else out
+
+
+# ---- continuous batching: coalesced requests, bit-exact per request ----
+
+def test_mixed_size_requests_bitexact(models):
+    b, X = models["bin"]
+    ref = _raw_ref(b, X[:600])
+    with Server(max_batch_wait_us=3000) as srv:
+        srv.register("m", b)
+        sizes = [1, 3, 57, 128, 200, 1, 64]
+        futs, lo = [], 0
+        for n in sizes:
+            futs.append((lo, n, srv.submit("m", X[lo:lo + n],
+                                           raw_score=True)))
+            lo += n
+        for lo, n, fut in futs:
+            np.testing.assert_array_equal(fut.result(timeout=60),
+                                          ref[lo:lo + n])
+        # several requests must actually have shared a dispatch
+        assert srv.batches < len(sizes)
+        assert srv.stats()["dropped"] == 0
+    # the objective transform matches the Booster-level predict epilogue
+    srv2 = Server(max_batch_wait_us=0)
+    srv2.register("m", b)
+    np.testing.assert_array_equal(srv2.predict("m", X[:600]),
+                                  b.predict(X[:600]))
+    srv2.close()
+
+
+def test_per_request_num_iteration_and_early_stop(models):
+    b, X = models["bin"]
+    with Server(max_batch_wait_us=1000) as srv:
+        srv.register("m", b)
+        # num_iteration subsets route through their own predictor range
+        f_full = srv.submit("m", X[:64], raw_score=True)
+        f_head = srv.submit("m", X[:64], raw_score=True, num_iteration=5)
+        np.testing.assert_array_equal(f_head.result(60),
+                                      _raw_ref(b, X[:64], num_iteration=5))
+        np.testing.assert_array_equal(f_full.result(60), _raw_ref(b, X[:64]))
+        # per-request prediction early stop (margin checked every freq
+        # trees) — bit-exact vs the engine with the same knobs, and
+        # genuinely truncating
+        es = srv.submit("m", X[:200], raw_score=True, pred_early_stop=True,
+                        pred_early_stop_margin=0.5,
+                        pred_early_stop_freq=3).result(60)
+        np.testing.assert_array_equal(
+            es, _raw_ref(b, X[:200], margin=0.5, freq=3))
+        assert not np.array_equal(es, _raw_ref(b, X[:200]))
+
+
+def test_early_stop_gate_on_accuracy_needing_objectives(models):
+    """Explicit pred_early_stop=True rides the same gate GBDT applies to
+    the config flag: objectives needing accurate raw scores (regression,
+    multiclass) serve WITHOUT truncation instead of corrupting scores."""
+    b, X = models["a"]                       # regression: gate must refuse
+    with Server(max_batch_wait_us=500) as srv:
+        srv.register("m", b)
+        got = srv.predict("m", X[:64], raw_score=True, pred_early_stop=True,
+                          pred_early_stop_margin=0.01,
+                          pred_early_stop_freq=1)
+        np.testing.assert_array_equal(got, _raw_ref(b, X[:64]))
+
+
+def test_explicit_early_stop_keeps_configured_margin():
+    """submit(pred_early_stop=True) without margin/freq serves with the
+    booster's CONFIGURED margin/freq — explicit True must not silently
+    downgrade an operator's margin to the engine fallback (10.0/10)."""
+    b, X = _train(seed=5, objective="binary", num_leaves=15, iters=12,
+                  pred_early_stop=True, pred_early_stop_margin=0.5,
+                  pred_early_stop_freq=3)
+    with Server(max_batch_wait_us=500) as srv:
+        srv.register("m", b)
+        exp = _raw_ref(b, X[:200], margin=0.5, freq=3)
+        np.testing.assert_array_equal(
+            srv.predict("m", X[:200], raw_score=True, pred_early_stop=True),
+            exp)
+        # and identical to the defaults path (pred_early_stop unspecified)
+        np.testing.assert_array_equal(
+            srv.predict("m", X[:200], raw_score=True), exp)
+
+
+def test_died_run_recovery_keeps_backpressure_and_latency():
+    """serve_reject / serve_fail events rebuild the rejected/failed
+    counters, and latency rebuilds from lat_max_s (queue wait included)
+    rather than dispatch-only dt_s — queueing delay must not vanish from
+    the post-mortem."""
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    ev = [
+        {"v": 1, "ts": 0.0, "kind": "serve_batch", "model": "m",
+         "requests": 2, "rows": 2, "bucket": 128, "fast": False,
+         "dt_s": 0.01, "lat_max_s": 1.5, "queue_depth": 9},
+        {"v": 1, "ts": 0.1, "kind": "serve_reject", "model": "m",
+         "queue_depth": 10},
+        {"v": 1, "ts": 0.2, "kind": "serve_fail", "model": "m",
+         "requests": 3, "error": "RuntimeError: boom"},
+    ]
+    s = obs_report.summary_from_events(ev)["serving"]
+    assert s["rejected"] == 1 and s["failed"] == 3
+    lat = s["models"]["m"]["latency_s"]
+    assert lat["count"] == 2 and abs(lat["p50"] - 1.5) < 1e-9
+
+
+def test_binned_requests(models):
+    b, X = models["bin"]
+    ds = b.train_data
+    with Server(max_batch_wait_us=1000) as srv:
+        srv.register("m", b)
+        got = srv.predict("m", ds.binned[:300], binned=True, raw_score=True)
+        np.testing.assert_array_equal(got, _raw_ref(b, X[:300]))
+        # binned and raw requests never share a batch but both complete
+        f1 = srv.submit("m", X[:40], raw_score=True)
+        f2 = srv.submit("m", ds.binned[:40], binned=True, raw_score=True)
+        np.testing.assert_array_equal(f1.result(60), f2.result(60))
+    # a model registered without a layout dataset rejects binned requests
+    loaded = GBDT(Config(verbosity=-1))
+    loaded.load_model_from_string(b.save_model_to_string())
+    srv2 = Server()
+    srv2.register("loaded", loaded)
+    with pytest.raises(Exception, match="binned"):
+        srv2.submit("loaded", ds.binned[:4], binned=True)
+    srv2.close()
+
+
+# ---- single-row fast path (model_codegen.compile_single_row) ----
+
+def test_single_row_fast_bitexact(models):
+    b, X = models["bin"]
+    ref = _raw_ref(b, X[:40])
+    with Server(max_batch_wait_us=500, single_row_fast=True) as srv:
+        srv.register("m", b)
+        for i in range(40):
+            got = srv.predict("m", X[i], raw_score=True)
+            np.testing.assert_array_equal(got, ref[i:i + 1])
+        assert srv.fast_served == 40
+        # transformed output matches the Booster epilogue too (>= 512 rows
+        # so b.predict takes the same device path the server always takes)
+        np.testing.assert_array_equal(srv.predict("m", X[7]),
+                                      b.predict(X[:600])[7:8])
+        # an early-stop request is NOT fast-path eligible (the compiled
+        # chain has no margin checks) — it falls back to the batched path
+        srv.predict("m", X[0], raw_score=True, pred_early_stop=True,
+                    pred_early_stop_margin=0.5)
+        assert srv.fast_served == 41  # 40 + the transformed row, not the ES
+
+
+def test_compile_single_row_goldens():
+    """The Tree::ToIfElse step pinned bit-exact vs predict_blocked on the
+    golden model classes: NaN routing, categorical (in-range / unseen /
+    negative / NaN), multiclass, and the deep-tree iterative fallback."""
+    # numeric + NaN routing
+    b, X = _train(seed=5, objective="binary", num_leaves=15, iters=12,
+                  nan_frac=0.08)
+    fn = compile_single_row(b)
+    ref = FusedPredictor(b.models)(X[:128])
+    got = np.array([fn(X[i])[0] for i in range(128)])
+    np.testing.assert_array_equal(ref, got)
+    # num_iteration subsets replay the same prefix
+    fn5 = compile_single_row(b, num_iteration=5)
+    ref5 = _raw_ref(b, X[:32], num_iteration=5)
+    np.testing.assert_array_equal(
+        ref5, np.array([fn5(X[i])[0] for i in range(32)]))
+    # categorical golden (the test_predict_fused shape)
+    rng = np.random.RandomState(0)
+    n, n_cats = 1200, 40
+    cat = rng.randint(0, n_cats, size=n)
+    y = np.isin(cat, [0, 3, 7, 33]) * 3.0 + rng.normal(scale=0.2, size=n)
+    Xc = np.column_stack([cat.astype(np.float64), rng.normal(size=n)])
+    dsc = BinnedDataset.from_matrix(Xc, label=y, categorical_feature=[0])
+    cfgc = Config(objective="regression", num_leaves=7, min_data_per_group=10,
+                  cat_smooth=1.0, max_cat_to_onehot=4, num_iterations=10,
+                  verbosity=-1)
+    bc = GBDT(cfgc, dsc, create_objective("regression", cfgc))
+    for _ in range(10):
+        bc.train_one_iter()
+    assert any(t.num_cat > 0 for t in bc.models)
+    Xq = np.concatenate([Xc[:64], [[99.0, 0.0], [np.nan, 0.0], [-3.0, 0.0]]]
+                        ).astype(np.float32)
+    refc = FusedPredictor(bc.models)(Xq)
+    fnc = compile_single_row(bc)
+    np.testing.assert_array_equal(refc,
+                                  np.array([fnc(r)[0] for r in Xq]))
+    # multiclass: per-class accumulation order
+    bm, Xm = _train(seed=6, objective="multiclass", num_class=3, iters=6)
+    fnm = compile_single_row(bm)
+    refm = _raw_ref(bm, Xm[:32])           # [K, n]
+    gotm = np.stack([fnm(Xm[i]) for i in range(32)], axis=1)
+    np.testing.assert_array_equal(refm, gotm)
+
+
+def test_compile_single_row_deep_tree_fallback(monkeypatch):
+    """Trees past the codegen nesting limit take the iterative closure —
+    still bit-exact (same floored-f32 thresholds and decide)."""
+    import lightgbm_tpu.model_codegen as mc
+    b, X = _train(seed=7, objective="binary", num_leaves=15, iters=6,
+                  nan_frac=0.05)
+    ref = FusedPredictor(b.models)(X[:64])
+    monkeypatch.setattr(mc, "_MAX_CODEGEN_DEPTH", 0)
+    fn = compile_single_row(b)
+    np.testing.assert_array_equal(
+        ref, np.array([fn(X[i])[0] for i in range(64)]))
+
+
+# ---- residency: LRU, budget, deferred eviction, re-admission, swap ----
+
+def _mb(entry_bytes):
+    return entry_bytes / float(1 << 20)
+
+
+def test_registry_budget_lru_eviction_and_readmit(models):
+    bA, XA = models["a"]
+    bB, XB = models["b"]
+    probe = ModelRegistry(budget_mb=0)          # unlimited, to size entries
+    e = probe.register("probe", bA)
+    one = e.resident_bytes
+    assert one > 0
+    # budget fits ~1.5 models: registering the second evicts the first
+    reg = ModelRegistry(budget_mb=_mb(int(one * 1.5)))
+    reg.register("a", bA)
+    reg.register("b", bB)
+    assert reg.resident_names() == ["b"]
+    assert reg.stats()["parked"] == ["a"]
+    assert reg.evictions == 1
+    # warm the buckets this test will touch, then pin re-admission on the
+    # gauge: the re-stacked arrays share shapes, so re-admitting recompiles
+    # at most once per bucket — and exactly zero here (bucket warmed)
+    entry_b = reg.acquire("b")
+    entry_b.predict(XB[:64], raw_score=True)
+    reg.release(entry_b)
+    base = recompile.total("predict_blocked")
+    entry_a = reg.acquire("a")               # transparent re-admission
+    entry_a.predict(XA[:64], raw_score=True)
+    reg.release(entry_a)
+    assert reg.readmits == 1
+    assert reg.resident_names() == ["a"]     # b LRU-evicted in turn
+    assert recompile.total("predict_blocked") - base == 0
+
+
+def test_eviction_defers_past_inflight_dispatch(models):
+    bA, XA = models["a"]
+    bB, _ = models["b"]
+    probe = ModelRegistry(budget_mb=0)
+    one = probe.register("probe", bA).resident_bytes
+    reg = ModelRegistry(budget_mb=_mb(int(one * 1.5)))
+    entry_a = reg.register("a", bA)
+    held = reg.acquire("a")                  # a batch is mid-dispatch
+    assert held is entry_a
+    reg.register("b", bB)                    # over budget -> wants to evict a
+    # the in-flight model is only MARKED; its arrays must survive the batch
+    assert entry_a.evict_pending and not entry_a.retired
+    assert entry_a._preds, "mid-dispatch eviction must defer"
+    assert "a" in reg.resident_names()
+    out = held.predict(XA[:16], raw_score=True)
+    np.testing.assert_array_equal(out, _raw_ref(bA, XA[:16]))
+    reg.release(held)                        # last in-flight batch completes
+    assert not entry_a._preds and "a" not in reg.resident_names()
+    assert reg.stats()["parked"] == ["a"]    # re-admittable
+
+
+def test_swap_atomic_republish(models):
+    bB, XB = models["b"]
+    bB2, _ = models["b2"]
+    refs_old = _raw_ref(bB, XB[:32])
+    refs_new = _raw_ref(bB2, XB[:32])
+    reg = ModelRegistry(budget_mb=0)
+    reg.register("b", bB)
+    old_entry = reg.acquire("b")             # in-flight on the OLD ensemble
+    new_entry = reg.swap("b", bB2, warm=(128,))
+    # in-flight requests finish on the old generation, bit-exact
+    np.testing.assert_array_equal(old_entry.predict(XB[:32], raw_score=True),
+                                  refs_old)
+    # new arrivals route to the new generation
+    got = reg.acquire("b")
+    assert got is new_entry
+    np.testing.assert_array_equal(got.predict(XB[:32], raw_score=True),
+                                  refs_new)
+    reg.release(got)
+    # the old predictor cache entry is dropped once its refcount drains
+    assert old_entry.retired and old_entry._preds
+    reg.release(old_entry)
+    assert not old_entry._preds
+    assert reg.swaps == 1
+    # swap of an unknown name is an error, not a silent register
+    with pytest.raises(Exception, match="register"):
+        reg.swap("nope", bB)
+
+
+def test_swap_under_load_zero_drops_zero_recompiles(models):
+    """The acceptance loop: mixed batch sizes, two resident models, one
+    hot-swap mid-run — zero dropped requests, recompile gauge flat after
+    warmup, every response bit-exact vs the generation that served it."""
+    bA, XA = models["a"]
+    bB, XB = models["b"]
+    bB2, _ = models["b2"]
+    sizes = (1, 17, 64, 200)
+    refs_a = {n: _raw_ref(bA, XA[:n]) for n in sizes}
+    refs_b = {n: _raw_ref(bB, XB[:n]) for n in sizes}
+    refs_b2 = {n: _raw_ref(bB2, XB[:n]) for n in sizes}
+    srv = Server(max_batch_wait_us=500)
+    srv.register("a", bA)
+    srv.register("b", bB)
+    for name, X in (("a", XA), ("b", XB)):
+        for n in sizes:
+            srv.predict(name, X[:n], raw_score=True)
+        srv.predict(name, np.zeros((1500, X.shape[1]), np.float32),
+                    raw_score=True)          # the coalesced-backlog rung
+    base = recompile.total()
+    results = []
+    lock = threading.Lock()
+
+    def traffic(tid):
+        rng = np.random.RandomState(tid)
+        outstanding = []
+        for i in range(30):
+            name = "a" if (i + tid) % 2 == 0 else "b"
+            n = int(sizes[rng.randint(len(sizes))])
+            fut = srv.submit(name, (XA if name == "a" else XB)[:n],
+                             raw_score=True)
+            with lock:
+                results.append((name, n, fut))
+            outstanding.append(fut)
+            if len(outstanding) >= 2:
+                outstanding.pop(0).result(60)
+
+    threads = [threading.Thread(target=traffic, args=(t,)) for t in range(3)]
+    for t in threads:
+        t.start()
+    # milestone-gated (not wall clock): >= 20% of the 90 requests in,
+    # >= 70 still to come — both generations see traffic on any box
+    deadline = time.monotonic() + 120
+    while True:
+        with lock:
+            submitted = len(results)
+        if submitted >= 18:
+            break
+        assert time.monotonic() < deadline, "traffic stalled before swap"
+        time.sleep(0.002)
+    srv.swap("b", bB2, warm=(128, 1024, 8192))
+    for t in threads:
+        t.join()
+    srv.close()
+    assert srv.stats()["dropped"] == 0 and srv.failed == 0
+    served_new = 0
+    for name, n, fut in results:
+        got = fut.result(60)
+        if name == "a":
+            np.testing.assert_array_equal(got, refs_a[n])
+        else:
+            new = np.array_equal(got, refs_b2[n])
+            served_new += new
+            assert new or np.array_equal(got, refs_b[n]), \
+                "response matched neither generation"
+    assert served_new > 0
+    assert recompile.total() - base == 0, \
+        "steady-state serving (incl. the swap) must not recompile"
+
+
+def test_registry_bytes_accounting_exact(models):
+    """Admission accounts each model's footprint exactly once; eviction,
+    swap and unregister give it all back (no phantom bytes — a long-lived
+    server's budget math must not drift)."""
+    bA, XA = models["a"]
+    bB, _ = models["b"]
+    reg = ModelRegistry(budget_mb=0)
+    e = reg.register("a", bA)
+    assert reg.stats()["bytes"] == e.resident_bytes
+    # a post-admission predictor range grows the accounting by its bytes
+    before = e.resident_bytes
+    e.predict(XA[:8], raw_score=True, num_iteration=3)
+    assert e.resident_bytes > before
+    assert reg.stats()["bytes"] == e.resident_bytes
+    e2 = reg.register("b", bB)
+    assert reg.stats()["bytes"] == e.resident_bytes + e2.resident_bytes
+    reg.unregister("a")
+    assert reg.stats()["bytes"] == e2.resident_bytes
+    reg.unregister("b")
+    assert reg.stats()["bytes"] == 0
+
+
+def test_concurrent_readmit_builds_once(models):
+    """Two threads acquiring the same parked model get ONE re-admission
+    (the second waits for the first build instead of duplicating it), and
+    the build never blocks other models' acquires."""
+    bA, _ = models["a"]
+    bB, _ = models["b"]
+    probe = ModelRegistry(budget_mb=0)
+    one = probe.register("probe", bA).resident_bytes
+    reg = ModelRegistry(budget_mb=_mb(int(one * 1.5)))
+    reg.register("a", bA)
+    reg.register("b", bB)                    # evicts a -> parked
+    assert reg.stats()["parked"] == ["a"]
+    got = []
+
+    def grab():
+        e = reg.acquire("a")
+        got.append(e)
+        reg.release(e)
+
+    threads = [threading.Thread(target=grab) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len({id(e) for e in got}) == 1, "readmit must build once"
+    assert reg.readmits == 1
+    assert reg.stats()["bytes"] == got[0].resident_bytes  # b evicted back
+
+
+def test_cancelled_future_does_not_poison_batch(models):
+    """A caller-cancelled request leaves its batch cleanly: co-batched
+    requests still complete with results, and the accounting stays exact
+    (cancelled counted, dropped pinned 0)."""
+    b, X = models["a"]
+    srv = Server(max_batch_wait_us=300_000)
+    srv.register("m", b)
+    opener = srv.submit("m", X[:4], raw_score=True)  # holds the window open
+    victim = srv.submit("m", X[:4], raw_score=True)
+    mate = srv.submit("m", X[4:8], raw_score=True)
+    assert victim.cancel(), "a still-pending future must be cancellable"
+    np.testing.assert_array_equal(mate.result(60), _raw_ref(b, X[4:8]))
+    np.testing.assert_array_equal(opener.result(60), _raw_ref(b, X[:4]))
+    srv.close()
+    stats = srv.stats()
+    assert stats["cancelled"] == 1
+    assert stats["completed"] == 2 and stats["failed"] == 0
+    assert stats["dropped"] == 0, "cancellation must not leak accounting"
+
+
+def test_rung_exact_requests_leave_no_tombstones(models):
+    """A request whose row count exactly equals a bucket rung skips the
+    absorb loops — its per-key index entry must still be drained (a stale
+    entry would pin the rows/result of every such request forever)."""
+    b, X = models["a"]
+    with Server(max_batch_wait_us=200) as srv:
+        srv.register("m", b)
+        Xr = np.zeros((128, X.shape[1]), np.float32)  # exactly the 128 rung
+        for _ in range(20):
+            srv.predict("m", Xr, raw_score=True)
+        with srv._cond:
+            assert not srv._by_key, "rung-exact requests leaked key-index " \
+                "tombstones"
+            assert not srv._pending
+
+
+def test_same_size_swap_does_not_evict_coresidents(models):
+    """Under a tight budget, swapping a model for a same-size replacement
+    gives the outgoing generation's bytes back BEFORE sizing the
+    admission — the co-resident model must stay resident."""
+    bA, _ = models["a"]
+    bB, _ = models["b"]
+    bB2, _ = models["b2"]
+    probe = ModelRegistry(budget_mb=0)
+    one = probe.register("probe", bA).resident_bytes
+    reg = ModelRegistry(budget_mb=_mb(int(one * 2)))   # exactly two fit
+    reg.register("a", bA)
+    reg.register("b", bB)
+    assert sorted(reg.resident_names()) == ["a", "b"]
+    reg.swap("b", bB2, warm=False)
+    assert sorted(reg.resident_names()) == ["a", "b"], \
+        "same-size swap must not evict the co-resident model"
+    assert reg.evictions == 0
+
+
+# ---- backpressure / lifecycle ----
+
+def test_queue_saturation_rejects_never_drops(models):
+    b, X = models["a"]
+    srv = Server(max_batch_wait_us=300_000, max_queue_depth=2)
+    srv.register("m", b)
+    # the open batch (popped by the dispatcher) holds the 300 ms window;
+    # further submits pile into the bounded queue
+    first = srv.submit("m", X[:4], raw_score=True)
+    deadline = time.monotonic() + 5.0
+    accepted, rejected = [first], 0
+    while time.monotonic() < deadline and rejected == 0:
+        try:
+            accepted.append(srv.submit("m", X[:4], raw_score=True))
+        except ServingQueueFull:
+            rejected += 1
+    assert rejected, "saturated queue must reject, not grow unboundedly"
+    # every ACCEPTED request still completes (zero dropped)
+    for fut in accepted:
+        np.testing.assert_array_equal(fut.result(60), _raw_ref(b, X[:4]))
+    stats = srv.stats()
+    assert stats["rejected"] >= 1 and stats["dropped"] == 0
+    srv.close()
+    with pytest.raises(ServingClosed):
+        srv.submit("m", X[:4])
+
+
+def test_close_without_drain_fails_pending_loudly(models):
+    b, X = models["a"]
+    srv = Server(max_batch_wait_us=300_000)
+    srv.register("m", b)
+    srv.submit("m", X[:4], raw_score=True)       # opens the long window
+    late = [srv.submit("m", np.zeros((2, X.shape[1]), np.float32))
+            for _ in range(3)]
+    srv.close(drain=False)
+    failed = sum(1 for f in late
+                 if isinstance(f.exception(timeout=60), ServingClosed))
+    # whatever the dispatcher already absorbed completed; the rest failed
+    # LOUDLY — nothing is silently dropped
+    assert failed + sum(1 for f in late if f.exception(timeout=60) is None) \
+        == len(late)
+    assert srv.stats()["dropped"] == 0
+
+
+# ---- telemetry: spy discipline + the serving summary block ----
+
+def test_serving_zero_telemetry_calls_when_off(models, monkeypatch):
+    from lightgbm_tpu.obs.registry import Telemetry
+    calls = []
+
+    def spy(name):
+        orig = getattr(Telemetry, name)
+
+        def wrapper(self, *a, **k):
+            calls.append((name, a))
+            return orig(self, *a, **k)
+        return wrapper
+
+    for name in ("event", "counter", "gauge", "histogram", "time_block"):
+        monkeypatch.setattr(Telemetry, name, spy(name))
+    assert obs.active() is None
+    b, X = models["a"]
+    bB, _ = models["b"]
+    with Server(max_batch_wait_us=200, single_row_fast=True) as srv:
+        srv.register("m", b)
+        srv.predict("m", X[:64], raw_score=True)
+        srv.predict("m", X[0], raw_score=True)
+        srv.swap("m", bB, warm=False)
+        srv.predict("m", X[:64], raw_score=True)
+    assert calls == [], "serving with telemetry off must make zero calls"
+
+
+def test_serving_summary_block_and_report(models, tmp_path):
+    from lightgbm_tpu.obs.report import human_table, summarize
+    b, X = models["a"]
+    bB, _ = models["b"]
+    out = str(tmp_path / "serve.jsonl")
+    tele = obs.configure(out=out, entry="test_serving")
+    with Server(max_batch_wait_us=500, single_row_fast=True) as srv:
+        srv.register("m", b)
+        for n in (1, 17, 64):
+            srv.predict("m", X[:n], raw_score=True)
+        srv.swap("m", bB, warm=False)
+        srv.predict("m", X[:32], raw_score=True)
+    summary = summarize(tele)
+    srv_block = summary["serving"]
+    m = srv_block["models"]["m"]
+    assert m["requests"] == 4 and m["rows"] == 1 + 17 + 64 + 32
+    assert m["latency_s"]["count"] == 4 and m["qps"] is not None
+    assert m["occupancy"]["count"] >= 3
+    assert srv_block["swaps"] == 1 and srv_block["single_row_fast"] == 1
+    assert srv_block["queue_depth"]["count"] >= 3
+    table = human_table(summary)
+    assert "serving:" in table and "model m" in table
+    tele.flush()
+    # died-run recovery: the serving block rebuilds from raw events alone
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    from lightgbm_tpu.obs.registry import read_events
+    rebuilt = obs_report.summary_from_events(read_events(out))
+    assert rebuilt["serving"]["models"]["m"]["requests"] == 4
+    assert rebuilt["serving"]["swaps"] == 1
+    assert "model m" in human_table(rebuilt)
+    obs.disable()
+
+
+def test_per_model_fallback_attribution(models, monkeypatch):
+    """A degraded dispatch under serving counts per MODEL (registry stats
+    site key + telemetry counter), not just globally."""
+    import lightgbm_tpu.core.predict_fused as pf
+    from lightgbm_tpu import resilience
+    b, X = models["a"]
+    tele = obs.configure(entry="test_fallback")
+    srv = Server(max_batch_wait_us=0)
+    srv.register("deg", b)
+    resilience.reset_fallbacks()
+    monkeypatch.setattr(pf, "predict_blocked",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("injected")))
+    got = srv.predict("deg", X[:32], raw_score=True)
+    np.testing.assert_array_equal(got, _raw_ref(b, X[:32]))  # degraded, exact
+    assert resilience.fallback_counts().get("predict_blocked@deg") == 1
+    assert tele.counter("predict_fallbacks_model_deg").value == 1
+    assert srv.registry.stats()["fallbacks"]["predict_blocked@deg"] == 1
+    srv.close()
+    obs.disable()
+
+
+def test_fallback_attribution_scoped_per_registry(models, monkeypatch):
+    """Two registries holding the SAME model name: a degraded dispatch on
+    one never shows in the other's stats (each registry tallies its own
+    predictors' fallbacks; the process-global ledger can't tell them
+    apart)."""
+    import lightgbm_tpu.core.predict_fused as pf
+    b, X = models["a"]
+    rA = ModelRegistry()
+    rA.register("model", b)
+    rB = ModelRegistry()
+    rB.register("model", b)
+    monkeypatch.setattr(pf, "predict_blocked",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("injected")))
+    entry = rB.acquire("model")
+    try:
+        entry.predict(X[:32])
+    finally:
+        rB.release(entry)
+    assert rB.stats()["fallbacks"]["predict_blocked@model"] == 1
+    assert "fallbacks" not in rA.stats()
+
+
+def test_swap_after_unregister_never_resurrects(models, monkeypatch):
+    """An unregister() landing while swap() stacks its replacement wins:
+    the swap raises instead of republishing the removed name — the same
+    defense register() and acquire() already have for this interleaving."""
+    from lightgbm_tpu.serving import registry as reg_mod
+    from lightgbm_tpu.utils.log import LightGBMError
+    bB, _ = models["b"]
+    bB2, _ = models["b2"]
+    r = ModelRegistry()
+    r.register("m", bB)
+    real_warm = reg_mod.ResidentModel.warm
+
+    def warm_then_unregister(self, *a, **k):
+        real_warm(self, *a, **k)
+        r.unregister("m")  # lands between the build and the name flip
+
+    monkeypatch.setattr(reg_mod.ResidentModel, "warm", warm_then_unregister)
+    with pytest.raises(LightGBMError, match="unregistered during its swap"):
+        r.swap("m", bB2)
+    assert not r.knows("m")
+
+
+def test_acquire_failure_never_resurrects_unregistered(models, monkeypatch):
+    """A re-admission build that fails AFTER a concurrent unregister()
+    removed the name must not re-park it — mirroring the success path's
+    zombie check."""
+    from lightgbm_tpu.serving import registry as reg_mod
+    bA, _ = models["a"]
+    bB, _ = models["b"]
+    r = ModelRegistry(budget_mb=1e-6)
+    r.register("m", bA)
+    r.register("n", bB)  # tiny budget: evicts idle "m" to parked
+    assert "m" in r.stats()["parked"]
+
+    def boom(self, *a, **k):
+        r.unregister("m")  # lands while the re-admission is building
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(reg_mod.ResidentModel, "__init__", boom)
+    with pytest.raises(RuntimeError, match="boom"):
+        r.acquire("m")
+    assert not r.knows("m")
+
+
+def test_wrong_width_rejected_at_intake(models):
+    """A malformed request is rejected at submit() — coalesced it would
+    fail its whole batch at np.concatenate, and dispatched alone the
+    out-of-range feature gather would CLAMP under jit into silently wrong
+    scores."""
+    from lightgbm_tpu.utils.log import LightGBMError
+    b, X = models["a"]
+    with Server(max_batch_wait_us=0) as srv:
+        srv.register("m", b)
+        with pytest.raises(LightGBMError, match="columns per raw row"):
+            srv.submit("m", X[:4, :-1])
+        # valid traffic is unaffected by the rejection
+        np.testing.assert_array_equal(
+            srv.predict("m", X[:32], raw_score=True), _raw_ref(b, X[:32]))
+
+
+def test_serving_block_rejected_only_run():
+    """A run where every request was rejected (queue saturated before any
+    batch dispatched) still renders a serving block — that is exactly when
+    the backpressure counters matter to the post-mortem reader."""
+    from lightgbm_tpu.obs.report import serving_block
+    blk = serving_block({"serve_rejected": 3}, {}, {})
+    assert blk is not None
+    assert blk["rejected"] == 3 and blk["batches"] == 0
+
+
+# ---- entry points ----
+
+def test_engine_and_booster_serve_entrypoints(models, tmp_path):
+    import lightgbm_tpu as lgb
+    b, X = models["a"]
+    bB, _ = models["b"]
+    path = str(tmp_path / "m.txt")
+    b.save_model(path)
+    ref = _raw_ref(b, X[:32])
+    # engine.serve over a dict of {name: Booster | path}
+    with lgb.serve({"live": b, "file": path},
+                   params={"max_batch_wait_us": 100}) as srv:
+        np.testing.assert_array_equal(srv.predict("live", X[:32],
+                                                  raw_score=True), ref)
+        np.testing.assert_array_equal(srv.predict("file", X[:32],
+                                                  raw_score=True), ref)
+        srv.swap("live", bB)
+    # Booster.serve
+    bst = lgb.Booster(model_file=path)
+    with bst.serve("m") as srv:
+        np.testing.assert_array_equal(srv.predict("m", X[:32],
+                                                  raw_score=True), ref)
+
+
+def test_cli_task_serve_matches_predict(tmp_path):
+    from lightgbm_tpu.cli import Application
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(1300, 6))
+    y = (X[:, 0] * 2 + X[:, 1] > 0).astype(float)
+    train = str(tmp_path / "d.train")
+    with open(train, "w") as fh:
+        for row, lab in zip(X[:700], y[:700]):
+            fh.write("%g\t" % lab
+                     + "\t".join("%g" % v for v in row) + "\n")
+    # >= 512 test rows: task=predict then takes the same fused device path
+    # serving always takes, so the outputs compare BIT-identical (below 512
+    # predict's f64 host path agrees to f32 rounding only)
+    test = str(tmp_path / "d.test")
+    with open(test, "w") as fh:
+        for row, lab in zip(X[700:], y[700:]):
+            fh.write("%g\t" % lab
+                     + "\t".join("%g" % v for v in row) + "\n")
+    model = str(tmp_path / "model.txt")
+    Application(["task=train", "data=%s" % train, "objective=binary",
+                 "num_trees=10", "num_leaves=15", "output_model=%s" % model,
+                 "verbosity=-1"]).run()
+    out_p = str(tmp_path / "p.txt")
+    out_s = str(tmp_path / "s.txt")
+    Application(["task=predict", "data=%s" % test, "input_model=%s" % model,
+                 "output_result=%s" % out_p, "verbosity=-1"]).run()
+    tele_out = str(tmp_path / "serve.jsonl")
+    Application(["task=serve", "data=%s" % test, "input_model=%s" % model,
+                 "output_result=%s" % out_s, "verbosity=-1",
+                 "serve_single_row_fast=true", "max_batch_wait_us=2000",
+                 "telemetry_out=%s" % tele_out]).run()
+    np.testing.assert_array_equal(np.loadtxt(out_p), np.loadtxt(out_s))
+    # the telemetry artifact carries the serving SLO block
+    import json
+    with open(tele_out + ".summary.json") as fh:
+        summary = json.load(fh)
+    assert summary["serving"]["models"]["model"]["requests"] == 600
+    assert summary["rows_served"] == 600
+    # leaf/contrib output modes are a different file format: serve must
+    # refuse them loudly instead of silently writing scores
+    with pytest.raises(Exception, match="task=predict"):
+        Application(["task=serve", "data=%s" % test,
+                     "input_model=%s" % model, "predict_contrib=true",
+                     "output_result=%s" % out_s, "verbosity=-1"]).run()
+
+
+def test_serving_config_params():
+    cfg = Config(max_batch_wait_us=500, serve_residency_budget_mb=64,
+                 serve_single_row_fast=True)
+    assert cfg.max_batch_wait_us == 500
+    assert cfg.serve_residency_budget_mb == 64.0
+    assert cfg.serve_single_row_fast is True
+    # aliases resolve like every other param
+    cfg2 = Config({"serve_batch_wait_us": 300, "single_row_fast": "true",
+                   "residency_budget_mb": 16})
+    assert cfg2.max_batch_wait_us == 300
+    assert cfg2.serve_single_row_fast is True
+    assert cfg2.serve_residency_budget_mb == 16.0
+    with pytest.raises(Exception):
+        Config(max_batch_wait_us=-1)
+    with pytest.raises(Exception):
+        Config(serve_residency_budget_mb=float("nan"))
+    # the Server honors config-sourced knobs
+    srv = Server(config=cfg)
+    assert srv.wait_s == pytest.approx(500e-6)
+    assert srv.single_row_fast is True
+    assert srv.registry.budget_bytes == 64 << 20
+    srv.close()

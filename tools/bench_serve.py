@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Serving-latency benchmark: fixed-qps open-loop load through the serving
+tier, p50/p99 per (qps, request-rows) cell in the BENCH artifact shape.
+
+The acceptance instrument for ROADMAP item 3: requests are submitted on an
+open-loop arrival schedule (arrival i fires at ``t0 + i/qps`` regardless of
+completions — the only schedule that exposes queueing collapse), per-request
+latency is measured submit -> future completion, and the grid of
+(qps, rows-per-request) cells lands in one JSON artifact shaped like the
+BENCH_r*.json trajectory entries so serving latency joins the training
+numbers.  The timed window also pins the serving invariants: the always-on
+recompile gauge must stay flat after warmup, and every accepted request must
+complete (dropped == 0).
+
+On this CPU box the absolute walls are proxies (XLA:CPU dispatch, no
+accelerator); the PERF.md round-13 protocol reruns this unchanged on TPU
+hardware with ``--telemetry-out`` for the full SLO block.
+
+Usage::
+
+    python tools/bench_serve.py --qps 200,1000 --request-rows 1,8,64 \
+        --seconds 2 --out BENCH_serve.json [--models 2] [--swap-mid-run]
+        [--single-row-fast] [--telemetry-out serve.jsonl]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="open-loop fixed-qps serving benchmark over the "
+                    "continuous-batching scheduler (p50/p99 per qps x "
+                    "request-rows cell, BENCH-shape artifact)")
+    ap.add_argument("--qps", default="200,1000",
+                    help="comma list of request rates to sweep")
+    ap.add_argument("--request-rows", default="1,8,64",
+                    help="comma list of rows per request (micro-batch sizes)")
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="duration of each open-loop window")
+    ap.add_argument("--models", type=int, default=2,
+                    help="resident models; traffic round-robins over them")
+    ap.add_argument("--swap-mid-run", action="store_true",
+                    help="hot-swap one model in the middle of every window "
+                         "(the train-while-serve republish drill)")
+    ap.add_argument("--rows", type=int, default=4000,
+                    help="training rows per model")
+    ap.add_argument("--features", type=int, default=10)
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--num-leaves", type=int, default=15)
+    ap.add_argument("--max-batch-wait-us", type=int, default=200)
+    ap.add_argument("--single-row-fast", action="store_true",
+                    help="serve batch-size-1 requests through the compiled "
+                         "single-row path")
+    ap.add_argument("--warm-max-rows", type=int, default=0,
+                    help="cap the warmed coalesced-batch size (0 = the "
+                         "worst case, one whole window in one batch); only "
+                         "cap when dispatch provably drains faster")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_serve.json",
+                    help="BENCH-shape artifact path")
+    ap.add_argument("--telemetry-out", default=None,
+                    help="also record a telemetry run (JSONL + summary with "
+                         "the serving SLO block)")
+    return ap.parse_args(argv)
+
+
+def _train_model(seed, rows, features, iterations, num_leaves):
+    import numpy as np
+
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(rows, features)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3)
+         + 0.1 * rng.normal(size=rows)).astype(np.float64)
+    cfg = Config(objective="regression", num_leaves=num_leaves,
+                 min_data_in_leaf=5, num_iterations=iterations,
+                 verbosity=-1)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
+                                   min_data_in_leaf=cfg.min_data_in_leaf)
+    b = GBDT(cfg, ds, create_objective(cfg.objective, cfg))
+    for _ in range(iterations):
+        b.train_one_iter()
+    return b, X
+
+
+def _tile_rows(pool, n):
+    """At least ``n`` rows from the pool — tiled, never silently fewer
+    (a cell labelled request_rows=8192 must actually carry 8192 rows)."""
+    import numpy as np
+    if n <= len(pool):
+        return pool
+    return np.tile(pool, (-(-n // len(pool)), 1))
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals)
+                                                        - 1)))))
+    return sorted_vals[i]
+
+
+def run_cell(server, names, pool, req_rows, qps, seconds, swap_fn=None):
+    """One open-loop window; returns the latency/throughput cell dict."""
+    import numpy as np
+    pool = _tile_rows(pool, req_rows)
+    interval = 1.0 / qps
+    n_req = max(int(seconds * qps), 1)
+    futures = []
+    t0 = time.perf_counter()
+    swapped = False
+    for i in range(n_req):
+        target = t0 + i * interval
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        if swap_fn is not None and not swapped and i >= n_req // 2:
+            swap_fn()
+            swapped = True
+        lo = (i * req_rows) % max(len(pool) - req_rows, 1)
+        t_sub = time.perf_counter()
+        fut = server.submit(names[i % len(names)], pool[lo:lo + req_rows],
+                            raw_score=True)
+        # completion time stamped by the dispatcher's done-callback, so the
+        # collection loop below cannot inflate earlier requests' latencies
+        done_at = {}
+        fut.add_done_callback(
+            lambda f, d=done_at: d.setdefault("t", time.perf_counter()))
+        futures.append((t_sub, done_at, fut))
+    lats = []
+    failed = 0
+    for t_sub, done_at, fut in futures:
+        try:
+            fut.result(timeout=120)
+            lats.append(done_at.get("t", time.perf_counter()) - t_sub)
+        except Exception:
+            failed += 1
+    wall = time.perf_counter() - t0
+    lats.sort()
+    return {
+        "qps": qps, "request_rows": req_rows, "requests": n_req,
+        "achieved_qps": n_req / wall if wall > 0 else None,
+        "failed": failed,
+        "p50_s": _quantile(lats, 0.50), "p99_s": _quantile(lats, 0.99),
+        "mean_s": (sum(lats) / len(lats)) if lats else None,
+        "max_s": lats[-1] if lats else None,
+    }
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np  # noqa: F401  (heavy imports post-argparse)
+
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import recompile
+    from lightgbm_tpu.serving import Server
+    from lightgbm_tpu.utils.file_io import atomic_write
+
+    if args.telemetry_out:
+        obs.configure(out=args.telemetry_out, entry="bench_serve")
+    qps_list = [float(q) for q in args.qps.split(",") if q]
+    rows_list = [int(r) for r in args.request_rows.split(",") if r]
+    models = {}
+    pools = {}
+    for i in range(max(args.models, 1)):
+        b, X = _train_model(args.seed + i, args.rows, args.features,
+                            args.iterations, args.num_leaves)
+        models["m%d" % i] = b
+        pools["m%d" % i] = X
+    names = sorted(models)
+    pool = pools[names[0]]
+    server = Server(max_batch_wait_us=args.max_batch_wait_us,
+                    single_row_fast=args.single_row_fast)
+    entries = {name: server.register(name, b)
+               for name, b in models.items()}
+
+    # warmup must cover every ladder rung the timed window can REACH, not
+    # just the per-request sizes: the scheduler retargets shape_bucket()
+    # after each absorb, so an overloaded window merges backlog into
+    # arbitrarily higher rungs — worst case one whole window in one batch
+    from lightgbm_tpu.core.predict_fused import PREDICT_BUCKETS, shape_bucket
+    worst = max(max(int(s), 1) * r
+                for s in (q * args.seconds for q in qps_list)
+                for r in rows_list)
+    if args.warm_max_rows > 0:
+        worst = min(worst, args.warm_max_rows)
+    top = shape_bucket(worst)
+    warm_rungs = tuple(b for b in PREDICT_BUCKETS if b <= top) or \
+        (PREDICT_BUCKETS[0],)
+    for name in names:
+        entries[name].warm(warm_rungs)
+        for r in sorted(set(rows_list)):
+            # and once through the full serve path (single-row fast compile)
+            server.predict(name, _tile_rows(pool, r)[:r], raw_score=True)
+    base_recompiles = recompile.total()
+
+    swap_seq = [0]
+
+    def make_swap_fn():
+        # train the replacement BEFORE the timed window opens: the swap
+        # call inside the arrival loop must only flip the name, or the
+        # cell's p50/p99 measure a training stall (and the burst catching
+        # the schedule back up) instead of serving-under-swap
+        swap_seq[0] += 1
+        b_new, _ = _train_model(args.seed + 1000 + swap_seq[0], args.rows,
+                                args.features, args.iterations,
+                                args.num_leaves)
+        return lambda: server.swap(names[-1], b_new, warm=warm_rungs)
+
+    grid = []
+    for req_rows in rows_list:
+        for qps in qps_list:
+            cell = run_cell(server, names, pool, req_rows, qps,
+                            args.seconds,
+                            swap_fn=make_swap_fn()
+                            if args.swap_mid_run else None)
+            grid.append(cell)
+            print("qps=%-8g rows=%-5d p50=%s p99=%s achieved=%s failed=%d"
+                  % (qps, req_rows,
+                     "-" if cell["p50_s"] is None else "%.6f" % cell["p50_s"],
+                     "-" if cell["p99_s"] is None else "%.6f" % cell["p99_s"],
+                     "-" if cell["achieved_qps"] is None
+                     else "%.0f" % cell["achieved_qps"],
+                     cell["failed"]), flush=True)
+    stats = server.stats()
+    server.close()
+    steady_recompiles = recompile.total() - base_recompiles
+    # headline: worst p99 across the grid (the SLO a fleet must plan for)
+    p99s = [c["p99_s"] for c in grid if c["p99_s"] is not None]
+    artifact = {
+        "metric": "serve_latency_p99_worst",
+        "value": max(p99s) if p99s else None,
+        "unit": "s",
+        "qps": qps_list, "request_rows": rows_list,
+        "seconds_per_cell": args.seconds,
+        "models_resident": len(names),
+        "swap_mid_run": bool(args.swap_mid_run),
+        "swaps": swap_seq[0],
+        "single_row_fast": bool(args.single_row_fast),
+        "single_row_fast_served": stats["single_row_fast"],
+        "recompiles_steady": steady_recompiles,
+        "dropped": stats["dropped"],
+        "rejected": stats["rejected"],
+        "grid": grid,
+        "device": os.environ.get("JAX_PLATFORMS", ""),
+    }
+    atomic_write(args.out, json.dumps(artifact, indent=1))
+    print(json.dumps({k: artifact[k] for k in
+                      ("metric", "value", "unit", "recompiles_steady",
+                       "dropped")}))
+    if args.telemetry_out:
+        from lightgbm_tpu.obs.report import finalize_run
+        finalize_run(obs.active(), extra={"bench": "serve"})
+        obs.disable()
+    if stats["dropped"]:
+        print("FAIL: %d requests dropped" % stats["dropped"],
+              file=sys.stderr)
+        return 1
+    if steady_recompiles:
+        print("WARNING: %d steady-state recompiles (expected 0 after "
+              "warmup)" % steady_recompiles, file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,11 @@ Scenarios (each prints PASS/FAIL and exits nonzero on failure):
                emergency checkpoint at the chunk boundary, exit 75, resume
                bit-exact — the checkpoint/preemption invariants hold under
                the new dispatch shape.
+  swap-under-load  The round-13 serving republish drill: two resident
+               models under concurrent request threads, one hot-swapped
+               mid-traffic.  Zero dropped requests (every response bit-exact
+               vs the generation that served it), zero steady-state
+               recompiles after warmup, old predictor entries fully dropped.
   all          Run every scenario.
 
 ``--matrix`` runs every scenario, prints a pass/fail table, and writes a
@@ -524,7 +529,143 @@ def scenario_level_preempt(workdir: str) -> None:
           "boundary and resumes bit-exact (resumed at iter %d)" % resumed)
 
 
+# ---- swap-under-load: hot-swap a resident model mid-traffic (round 13) ----
+
+def scenario_swap_under_load(workdir: str) -> None:
+    """The serving tier's republish drill: two resident models under
+    concurrent request threads, one hot-swapped mid-traffic.  Asserts ZERO
+    dropped requests (every accepted future resolves, each bit-exact vs the
+    generation that served it), ZERO steady-state recompiles after warmup
+    (the swap republish is a pure jit-cache hit — premise-checked by
+    comparing stacked shapes), and the old model's predictor entries fully
+    dropped once its in-flight batches drained."""
+    import threading
+
+    import numpy as np
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.core.predict_fused import FusedPredictor
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+    from lightgbm_tpu.obs import recompile
+    from lightgbm_tpu.serving import Server
+
+    def train(seed):
+        rng = np.random.RandomState(seed)
+        X = rng.uniform(-2, 2, size=(800, 6)).astype(np.float32)
+        y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2)
+             + 0.1 * rng.normal(size=800)).astype(np.float64)
+        cfg = Config(objective="regression", num_leaves=8,
+                     min_data_in_leaf=5, verbosity=-1, num_iterations=10)
+        ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
+                                       min_data_in_leaf=cfg.min_data_in_leaf)
+        b = create_boosting(cfg.boosting, cfg, ds,
+                            create_objective(cfg.objective, cfg))
+        for _ in range(10):
+            b.train_one_iter()
+        return b, X
+
+    bA, XA = train(0)
+    bB, XB = train(1)
+    bB2, _ = train(2)
+    fpA, fpB, fpB2 = (FusedPredictor(b.models) for b in (bA, bB, bB2))
+    # premise for the zero-recompile assertion: the replacement stacks to
+    # the SAME ensemble shapes, so the swap is a pure jit-cache hit
+    assert [a.shape for a in fpB2.ens] == [a.shape for a in fpB.ens], \
+        "replacement model stacked to different shapes; adjust training"
+    sizes = (1, 17, 64, 200)
+    refs = {"a": {n: fpA(XA[:n]) for n in sizes}}
+    refs_b_old = {n: fpB(XB[:n]) for n in sizes}
+    refs_b_new = {n: fpB2(XB[:n]) for n in sizes}
+
+    srv = Server(max_batch_wait_us=500)
+    srv.register("a", bA)
+    srv.register("b", bB)
+    # warm every bucket the traffic can coalesce into: request sizes reach
+    # the 128/1024 rungs directly, and 4 threads x 2-outstanding x 200 rows
+    # of backlog can merge into the 8192 rung
+    for name, X in (("a", XA), ("b", XB)):
+        for n in sizes:
+            srv.predict(name, X[:n], raw_score=True)
+        srv.predict(name, np.zeros((1500, X.shape[1]), np.float32),
+                    raw_score=True)
+    base = recompile.total()
+    old_entry = srv.registry._resident["b"]
+
+    results = []
+    res_lock = threading.Lock()
+
+    def traffic(tid):
+        # closed-loop with a 2-deep pipeline per thread: enough concurrency
+        # to overlap the swap, bounded backlog so the coalescer stays inside
+        # the warmed rungs
+        rng = np.random.RandomState(100 + tid)
+        outstanding = []
+        for i in range(60):
+            name = "a" if (i + tid) % 2 == 0 else "b"
+            n = int(sizes[rng.randint(len(sizes))])
+            X = XA if name == "a" else XB
+            fut = srv.submit(name, X[:n], raw_score=True)
+            with res_lock:
+                results.append((name, n, fut))
+            outstanding.append(fut)
+            if len(outstanding) >= 2:
+                outstanding.pop(0).result()
+
+    threads = [threading.Thread(target=traffic, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    # gate the swap on a traffic MILESTONE, not wall clock: with >= 20% of
+    # the 240 requests submitted, >= 180 are still to come, so requests are
+    # guaranteed on both sides of the republish on any machine speed
+    deadline = time.time() + 120
+    while True:
+        with res_lock:
+            submitted = len(results)
+        if submitted >= 48:
+            break
+        assert time.time() < deadline, "traffic stalled before the swap"
+        time.sleep(0.002)
+    srv.swap("b", bB2, warm=(128, 1024, 8192))  # the mid-traffic republish
+    for t in threads:
+        t.join()
+    srv.close()
+
+    stats = srv.stats()
+    assert stats["dropped"] == 0 and stats["failed"] == 0, stats
+    assert stats["completed"] == stats["submitted"] == len(results) + \
+        2 * (len(sizes) + 1), stats
+    mismatches = served_old = served_new = 0
+    for name, n, fut in results:
+        got = fut.result(timeout=60)
+        if name == "a":
+            ok = np.array_equal(got, refs["a"][n])
+        else:
+            old = np.array_equal(got, refs_b_old[n])
+            new = np.array_equal(got, refs_b_new[n])
+            served_old += old
+            served_new += new
+            ok = old or new
+        mismatches += not ok
+    assert mismatches == 0, "%d responses matched neither generation" \
+        % mismatches
+    assert served_new > 0, "no request reached the swapped-in model"
+    delta = recompile.total() - base
+    assert delta == 0, "swap-under-load recompiled %d times after warmup" \
+        % delta
+    assert old_entry.retired and not old_entry._preds and \
+        old_entry.inflight == 0, "old model not fully evicted after swap"
+    assert srv.registry.stats()["swaps"] == 1
+    print("PASS swap-under-load: %d requests (%d on the old generation, %d "
+          "on the new) served bit-exact with 0 drops, 0 steady-state "
+          "recompiles; old predictor entries dropped"
+          % (len(results), served_old, served_new))
+
+
 SCENARIOS = {"kill-write": scenario_kill_write,
+             "swap-under-load": scenario_swap_under_load,
              "level-preempt": scenario_level_preempt,
              "corrupt": scenario_corrupt,
              "nan-grad": scenario_nan_grad,
@@ -579,6 +720,8 @@ def main(argv=None) -> int:
                     help="scratch directory (default: a fresh tempdir)")
     args = ap.parse_args(argv)
     import tempfile
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
     workdir = args.workdir or tempfile.mkdtemp(prefix="lgbm_fault_")
     sys.path.insert(0, REPO)
     if args.matrix:

@@ -75,6 +75,11 @@ def summary_from_events(events):
     hists = {}
     counters = {}
     recompiles = {}
+    # serving rollup from serve_* events: the per-request latency histogram
+    # is gone with the process, but batch latency/occupancy/queue depth and
+    # the per-model request counts reconstruct from the stream
+    srv_counters = {}
+    srv_hists = {}
     # resilience event kind -> summary-counter name (the faults a died run
     # absorbed are exactly what its post-mortem reader wants first)
     res_kinds = {"preempt_checkpoint": "preemptions",
@@ -99,7 +104,57 @@ def summary_from_events(events):
             # several programs in one dispatch)
             key = "%s|%s" % (e.get("fn", "?"), e.get("bucket", "?"))
             recompiles[key] = recompiles.get(key, 0) + int(e.get("n", 1))
+        if e["kind"] == "serve_batch":
+            m = str(e.get("model", "?"))
+            for ck, n in (("serve_batches", 1),
+                          ("serve_requests_model_%s" % m,
+                           int(e.get("requests", 1))),
+                          ("serve_rows_model_%s" % m, int(e.get("rows", 0))),
+                          ("serve_single_row_fast",
+                           1 if e.get("fast") else 0)):
+                if n:
+                    srv_counters[ck] = srv_counters.get(ck, 0) + n
+            # lat_max_s (submit→complete of the batch's oldest request,
+            # queue wait included) approximates request latency from
+            # above; dispatch-only dt_s would understate it exactly when
+            # queueing delay is the failure being investigated
+            lat = e.get("lat_max_s", e.get("dt_s"))
+            if isinstance(lat, (int, float)):
+                h = srv_hists.setdefault("serve_latency_s_model_%s" % m,
+                                         Histogram())
+                for _ in range(max(int(e.get("requests", 1)), 1)):
+                    h.observe(lat)
+            if isinstance(e.get("queue_depth"), (int, float)):
+                srv_hists.setdefault("serve_queue_depth",
+                                     Histogram()).observe(e["queue_depth"])
+            if isinstance(e.get("rows"), (int, float)) \
+                    and isinstance(e.get("bucket"), (int, float)) \
+                    and e["bucket"]:
+                srv_hists.setdefault("serve_occupancy_model_%s" % m,
+                                     Histogram()).observe(
+                    e["rows"] / float(e["bucket"]))
+        elif e["kind"] in ("serve_evict", "serve_swap", "serve_readmit",
+                           "serve_reject"):
+            ck = {"serve_evict": "serve_evictions",
+                  "serve_swap": "serve_swaps",
+                  "serve_readmit": "serve_readmits",
+                  "serve_reject": "serve_rejected"}[e["kind"]]
+            srv_counters[ck] = srv_counters.get(ck, 0) + 1
+        elif e["kind"] == "serve_fail":
+            srv_counters["serve_failed"] = (
+                srv_counters.get("serve_failed", 0)
+                + max(int(e.get("requests", 1)), 1))
+        elif e["kind"] == "predict_fallback" and e.get("model"):
+            # degraded dispatches carry the owning model: the post-mortem
+            # reader needs the per-model fallback signal most of all
+            ck = "predict_fallbacks_model_%s" % e["model"]
+            srv_counters[ck] = srv_counters.get(ck, 0) + 1
+    from lightgbm_tpu.obs.report import serving_block
+    serving = serving_block(
+        srv_counters, {},
+        {k: h.summary() for k, h in srv_hists.items()})
     return {
+        **({"serving": serving} if serving else {}),
         "resilience": resilience,
         "metric": "telemetry_run", "unit": "row-trees/s", "value": None,
         "iterations": None, "wall_s": None,
