@@ -39,6 +39,7 @@ from ..obs import active as _telemetry_active
 from ..obs import annotate as _annotate
 from ..obs import launches as _launches
 from ..obs import recompile as _recompile
+from ..obs import spans as _spans
 from ..resilience import preemption_requested as _preemption_requested
 from ..resilience import watch as _watch
 from ..utils.file_io import atomic_write
@@ -1091,6 +1092,12 @@ class GBDT:
         tele.event("train_chunk", first_iter=int(first_iter),
                    iters=int(iters), dt_s=dt, rows_per_s=rate,
                    fused=bool(fused), bag_data_cnt=int(self.bag_data_cnt))
+        # span under the run trace: chunks line up as the training
+        # lifeline in the Chrome-trace render (obs/spans.py)
+        _spans.record_span(tele, "train_chunk", t0=time.time() - dt,
+                           dur_s=dt, trace_id=tele.trace_id,
+                           first_iter=int(first_iter), iters=int(iters),
+                           fused=bool(fused))
 
     def _train_one_iter_sync(self, gradients: Optional[np.ndarray] = None,
                              hessians: Optional[np.ndarray] = None) -> bool:

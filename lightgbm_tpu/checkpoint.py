@@ -177,6 +177,18 @@ def deserialize_state(blob: bytes
 
 # ---- save / load / discover ----
 
+# wall-clock time of the newest successful checkpoint write in this
+# process: /healthz (obs/exporter.py) surfaces its age so an operator can
+# see how much work a preemption right now would lose
+_LAST_WRITE_TS: Optional[float] = None
+
+
+def last_checkpoint_time() -> Optional[float]:
+    """Unix time of this process's newest successful checkpoint write
+    (None before the first one)."""
+    return _LAST_WRITE_TS
+
+
 def save_checkpoint(booster, prefix: str, keep: Optional[int] = None) -> str:
     """Capture the booster's full train state and write it atomically to
     ``<prefix>.ckpt_iter_<iteration>``; prune to the newest ``keep`` files
@@ -184,20 +196,28 @@ def save_checkpoint(booster, prefix: str, keep: Optional[int] = None) -> str:
     import time
 
     from .utils.timer import FunctionTimer
+    global _LAST_WRITE_TS
     t0 = time.perf_counter()
+    ts0 = time.time()
     with FunctionTimer("Checkpoint::Write"):
         meta, arrays, model_str = booster.capture_train_state()
         path = checkpoint_path(prefix, int(meta["iteration"]))
         blob = serialize_state(meta, arrays, model_str)
         atomic_write(path, blob)
+    _LAST_WRITE_TS = time.time()
     Log.info("Wrote checkpoint %s", path)
     from .obs import active as _telemetry_active
     tele = _telemetry_active()
     if tele is not None:
+        from .obs import spans
         dt = time.perf_counter() - t0
         tele.histogram("checkpoint_write_s").observe(dt)
         tele.event("checkpoint_write", iteration=int(meta["iteration"]),
                    dt_s=dt, bytes=len(blob))
+        # a span too: the write shows on the run's trace lifeline between
+        # the train_chunk slices it interleaves with
+        spans.record_span(tele, "checkpoint_write", t0=ts0, dur_s=dt,
+                          iteration=int(meta["iteration"]))
     if keep is None:
         keep = int(getattr(booster.config, "snapshot_keep", 0))
     prune_checkpoints(prefix, keep)

@@ -98,20 +98,24 @@ class Application:
 
     def _configure_telemetry(self):
         """Start a telemetry run when the config asks for one
-        (telemetry_out=...); returns the Telemetry or None."""
+        (``telemetry_out=...`` and/or a live scrape surface via
+        ``metrics_port>0``); returns the Telemetry or None.  Under a pod
+        each process records into its own ``<out>.rank<k>.jsonl`` shard
+        (obs.configure resolves the rank) and only the leader writes the
+        summary at finalize — ``tools/obs_report.py --merge`` reassembles
+        the shards."""
         cfg = self.config
         t_out = str(getattr(cfg, "telemetry_out", "") or "")
-        if not t_out:
-            return None
-        from .parallel.learners import is_write_leader
-        if not is_write_leader(None):
-            # same leader-only file discipline as model/checkpoint writes:
-            # d pod processes must not truncate/interleave one JSONL path
-            Log.debug("telemetry_out ignored on non-leader process")
+        m_port = int(getattr(cfg, "metrics_port", 0))
+        if not t_out and m_port <= 0:
             return None
         from . import obs
-        return obs.configure(out=t_out,
+        return obs.configure(out=t_out or None,
                              freq=int(getattr(cfg, "telemetry_freq", 1)),
+                             metrics_port=m_port,
+                             metrics_addr=str(
+                                 getattr(cfg, "metrics_addr", "")
+                                 or "127.0.0.1"),
                              entry="cli", task=str(cfg.task))
 
     @staticmethod

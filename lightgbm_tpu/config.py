@@ -275,6 +275,17 @@ class Config:
         if not math.isfinite(float(self.serve_residency_budget_mb)):
             Log.fatal("serve_residency_budget_mb must be finite (use <= 0 "
                       "for unlimited residency)")
+        # round-14 live-plane params: a non-loopback bind is an explicit
+        # operator decision (the endpoint has no auth), warn so it never
+        # happens by accident
+        self.metrics_addr = str(self.metrics_addr).strip() or "127.0.0.1"
+        if int(self.metrics_port) > 0 \
+                and self.metrics_addr not in ("127.0.0.1", "localhost",
+                                              "::1"):
+            Log.warning("metrics_port=%d binds %s: the observability "
+                        "endpoint is unauthenticated — make sure the "
+                        "network perimeter covers it",
+                        int(self.metrics_port), self.metrics_addr)
         if ("io_retry_attempts" in self.raw_params
                 or "io_retry_backoff_s" in self.raw_params):
             # the retry policy guards a process-global primitive

@@ -24,6 +24,7 @@ the BinMappers, like Dataset::RealThreshold).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from typing import NamedTuple, Optional
@@ -1727,10 +1728,28 @@ class SerialTreeLearner:
                 else (self.cegb[0], self.cegb[1], self.cegb_used,
                       self.cegb[2]))
         lazy_active = cegb is not None and cegb[3] is not None
+        from ..obs import active as _telemetry_active
         from ..obs import launches as _launches
         grow_mode = self.effective_grow_mode()
         _launches.record(grow_mode, self.launches_per_tree())
-        with FunctionTimer("Partition::BuildTree(dispatch)"), \
+        # tree-build span (host dispatch wall) carrying the level-dispatch
+        # structure: a tree build is ONE compiled program, so per-level
+        # host timing does not exist — the launch gauge and these fields
+        # are the honest per-level signal.  Guarded like every hot-path
+        # site: a traced caller (parallel learners' shard_map build) and a
+        # telemetry-off run both skip it entirely.
+        tele = _telemetry_active()
+        span_ctx = contextlib.nullcontext()
+        if tele is not None and not isinstance(grad, jax.core.Tracer):
+            from ..obs import spans as _spans
+            fields = dict(mode=grow_mode,
+                          launches=int(self.launches_per_tree()))
+            if grow_mode == "level":
+                fields.update(levels=self.level_count(),
+                              classes=self.level_classes())
+            span_ctx = _spans.Span(tele, "tree_build", tele.trace_id,
+                                   None, fields)
+        with span_ctx, FunctionTimer("Partition::BuildTree(dispatch)"), \
                 _annotate("partition_build_tree"):
             out = build_tree_partitioned(
                 self.bins, grad, hess,

@@ -162,14 +162,21 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
     # telemetry: a telemetry_out param turns this run self-recording (JSONL
     # events + <out>.summary.json); a run configured by the caller (bench.py)
-    # is recorded into but finalized by its owner
+    # is recorded into but finalized by its owner.  Under a pod every rank
+    # records into its own <out>.rank<k>.jsonl shard (obs.configure picks
+    # the path) and only the leader writes the merged summary at finalize;
+    # metrics_port > 0 additionally serves the run live over HTTP
+    # (obs/exporter.py), with an in-memory run when telemetry_out is unset.
     t_out = str(getattr(booster.config, "telemetry_out", "") or "")
+    m_port = int(getattr(booster.config, "metrics_port", 0))
     from .parallel.learners import is_write_leader
-    if t_out and is_write_leader(None):
-        # leader-only like model/checkpoint writes: d pod processes must
-        # not truncate/interleave the same JSONL + summary paths
+    if t_out or m_port > 0:
         tele = obs.configure(
-            out=t_out, freq=int(getattr(booster.config, "telemetry_freq", 1)),
+            out=t_out or None,
+            freq=int(getattr(booster.config, "telemetry_freq", 1)),
+            metrics_port=m_port,
+            metrics_addr=str(getattr(booster.config, "metrics_addr", "")
+                             or "127.0.0.1"),
             entry="engine.train")
         own_tele = True
     else:
@@ -319,10 +326,17 @@ def serve(models, params: Optional[Dict[str, Any]] = None, **server_kwargs):
 
     cfg = Config(alias_transform(dict(params or {})))
     t_out = str(getattr(cfg, "telemetry_out", "") or "")
+    m_port = int(getattr(cfg, "metrics_port", 0))
     own_tele = None
-    if t_out and obs.active() is None:
-        own_tele = obs.configure(out=t_out,
+    if (t_out or m_port > 0) and obs.active() is None:
+        # metrics_port without telemetry_out still gets a (memory-sink)
+        # run: the live scrape surface needs a registry to render
+        own_tele = obs.configure(out=t_out or None,
                                  freq=int(getattr(cfg, "telemetry_freq", 1)),
+                                 metrics_port=m_port,
+                                 metrics_addr=str(
+                                     getattr(cfg, "metrics_addr", "")
+                                     or "127.0.0.1"),
                                  entry="engine.serve")
     server = None
     try:

@@ -1,5 +1,8 @@
 """Unified telemetry: metrics registry, JSONL events, recompile accounting,
-trace annotations, MFU estimation, end-of-run reports.
+trace annotations, MFU estimation, end-of-run reports — and, since round
+14, the LIVE observability plane: an HTTP scrape surface
+(:mod:`.exporter`: ``/metrics`` ``/healthz`` ``/summary.json``),
+request-scoped spans (:mod:`.spans`) and rank-aware pod shard sinks.
 
 The observability layer the reference ships as layer 0
 (``Common::Timer``/``global_timer``, common.h:1032-1093) rebuilt for the
@@ -14,40 +17,90 @@ Enable from any entry point with the ``telemetry_out`` (JSONL path) and
 ``telemetry_freq`` (per-iteration event cadence) params; ``engine.train``,
 the CLI and ``bench.py`` all finalize the run into
 ``<telemetry_out>.summary.json`` via :func:`~.report.finalize_run`.
+``metrics_port`` additionally serves the run live over HTTP.  Under a
+multi-process pod each host writes its own ``<out>.rank<k>.jsonl`` shard
+(every event rank-stamped; ``tools/obs_report.py --merge`` reassembles the
+pod view) and only the leader writes the summary.
 Recompile accounting (:mod:`.recompile`) is the one always-on piece: it
 costs an integer compare per dispatch and is what turns the "steady-state
 serving never recompiles" invariant into a readable gauge.
 """
 from __future__ import annotations
 
+import os as _os
 import threading
 from typing import Any, Optional
 
 from . import recompile  # noqa: F401  (re-export)
 from .registry import (EVENT_SCHEMA_VERSION, Counter, Gauge, Histogram,
-                       MetricsRegistry, Telemetry, read_events,
-                       validate_event)
+                       MetricsRegistry, Telemetry, iter_events, read_events,
+                       shard_path, validate_event)
 from .trace import annotate
 
 __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "EVENT_SCHEMA_VERSION", "read_events", "validate_event",
-           "configure", "active", "disable", "annotate", "recompile"]
+           "EVENT_SCHEMA_VERSION", "read_events", "iter_events",
+           "validate_event", "shard_path", "configure", "active", "disable",
+           "annotate", "recompile", "spans"]
 
 _lock = threading.Lock()
 _active: Optional[Telemetry] = None
 
+# forces a pod rank without a jax distributed runtime (the 8-device dryrun
+# and the tests simulate multi-host shard sinks through it)
+RANK_ENV = "LIGHTGBM_TPU_TELEMETRY_RANK"
+
+
+def _resolve_rank(rank: Optional[int]):
+    """(rank, pod_mode): explicit arg > env override > jax process index.
+    ``pod_mode`` turns the JSONL sink into a per-rank shard; a plain
+    single-process run keeps rank None and the unsharded path."""
+    if rank is not None:
+        return int(rank), True
+    env = _os.environ.get(RANK_ENV)
+    if env:
+        return int(env), True
+    try:
+        # the import is real (not sys.modules-gated): a pod CLI process
+        # that configures telemetry before its first jit would otherwise
+        # resolve single-host and d hosts would truncate/interleave ONE
+        # JSONL path — the corruption the old leader-only gate prevented.
+        # Every real run imports jax moments later anyway; environments
+        # without jax degrade to single-host.
+        import jax
+        if jax.process_count() > 1:
+            return int(jax.process_index()), True
+    except Exception:
+        pass
+    return None, False
+
 
 def configure(out: Optional[str] = None, freq: int = 1,
-              **meta: Any) -> Telemetry:
+              rank: Optional[int] = None, metrics_port: int = 0,
+              metrics_addr: str = "127.0.0.1", **meta: Any) -> Telemetry:
     """Install the process-active telemetry run (closing any previous one).
-    ``out`` is the JSONL sink path (None keeps events in memory); extra
-    kwargs land on the ``run_start`` event."""
+
+    ``out`` is the JSONL sink path (None keeps events in memory); under a
+    pod (multi-process jax, an explicit ``rank``, or the
+    ``LIGHTGBM_TPU_TELEMETRY_RANK`` override) the sink becomes the
+    per-host shard ``<out>.rank<k>.jsonl`` and every event is
+    rank-stamped.  ``metrics_port > 0`` starts the live HTTP exporter
+    (``/metrics`` ``/healthz`` ``/summary.json``) on the run; it is shut
+    down by ``Telemetry.close()``/:func:`disable`.  Extra kwargs land on
+    the ``run_start`` event."""
     global _active
-    tele = Telemetry(out=out, freq=freq, meta=meta)
+    rank, pod = _resolve_rank(rank)
+    sink = shard_path(out, rank) if (out and pod) else out
+    tele = Telemetry(out=sink, freq=freq, meta=meta, rank=rank,
+                     summary_base=out)
     with _lock:
         prev, _active = _active, tele
     if prev is not None:
+        # close (and release any exporter port) BEFORE binding the new
+        # listener: back-to-back runs may reuse one fixed metrics_port
         prev.close()
+    if int(metrics_port) > 0:
+        from .exporter import start_exporter
+        start_exporter(tele, port=int(metrics_port), addr=metrics_addr)
     return tele
 
 
@@ -63,3 +116,10 @@ def disable() -> None:
         prev, _active = _active, None
     if prev is not None:
         prev.close()
+
+
+# spans is re-exported here (placed after active() exists to dodge the
+# cycle); exporter is NOT imported eagerly — it drags http.server into
+# every telemetry-off `import lightgbm_tpu`, and all its call sites
+# (configure, serving.Server, Telemetry.close) reach it lazily
+from . import spans  # noqa: E402,F401

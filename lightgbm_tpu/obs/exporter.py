@@ -1,0 +1,309 @@
+"""Live metrics/health endpoint: the scrape surface of the telemetry run.
+
+Everything the obs subsystem records was post-mortem until now — you
+learned a run's p99 or recompile count from ``<out>.summary.json`` after
+it exited.  This module serves the SAME data live from a stdlib
+``http.server`` thread so an operator (or Prometheus) can ask a running
+``task=train`` / ``task=serve`` process how it is doing:
+
+- ``GET /metrics`` — Prometheus text exposition rendered from the active
+  run's ``MetricsRegistry.snapshot()`` plus the always-on process gauges
+  (recompiles per (function, bucket), tree-kernel launches per mode,
+  predict fallbacks per site, io retries) — the counters that are live
+  even when no telemetry run is configured.
+- ``GET /healthz`` — liveness JSON: preemption-flag state (``draining``
+  during the SIGTERM grace window), watchdog state (open dispatch
+  sections and their ages; ``stalled`` + HTTP 503 once it fired), serving
+  queue depth / inflight counts from registered health providers, and the
+  age of the last checkpoint write.
+- ``GET /summary.json`` — the live ``report.summarize`` shape (exactly
+  what ``finalize_run`` would write right now).
+
+Enablement follows the telemetry ownership rules: ``metrics_port > 0``
+(param, wired through ``engine.train`` / ``engine.serve`` / the CLI)
+starts the listener on the run the driver configures, and
+``Telemetry.close()`` shuts it down with the run.  When off — the default
+— there is NO listener thread and the hot paths make zero exporter calls
+(spy-pinned in tests/test_telemetry.py).  Handlers only ever READ
+lock-protected snapshots, so a scrape mid-train cannot block a dispatch.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.log import Log
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "lgbm_tpu_"
+
+# name -> zero-arg callable returning a small scalar dict folded into
+# /healthz; the serving tier registers its queue/inflight counts here.
+# Registration is a constructor-time dict write (never hot-path work).
+_providers: Dict[str, Callable[[], Dict[str, Any]]] = {}
+_plock = threading.Lock()
+
+
+def register_health_provider(name: str,
+                             fn: Callable[[], Dict[str, Any]]) -> str:
+    """Register ``fn`` under ``name`` and return the key actually used:
+    a second registrant of the same name gets ``name#2`` (two Servers in
+    one process must both stay visible on /healthz, not evict each
+    other).  Unregister with the RETURNED key."""
+    with _plock:
+        key, n = name, 1
+        while key in _providers:
+            n += 1
+            key = "%s#%d" % (name, n)
+        _providers[key] = fn
+    return key
+
+
+def unregister_health_provider(name: str, fn=None) -> None:
+    """Remove ``name``'s provider; when ``fn`` is given, only if it is
+    still the registered one (a newer registrant must not be torn down by
+    a stale owner's close).  Equality, not identity: bound methods are
+    fresh objects per attribute access."""
+    with _plock:
+        if fn is None or _providers.get(name) == fn:
+            _providers.pop(name, None)
+
+
+def _prom_name(name: str) -> str:
+    return _PREFIX + _PROM_BAD.sub("_", str(name))
+
+
+def _prom_val(v) -> str:
+    if v is None:
+        return "NaN"
+    return repr(float(v))
+
+
+def _esc_label(v) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+def render_prometheus(snapshot: Dict[str, Any],
+                      run_recompiles: Optional[int] = None) -> str:
+    """Registry snapshot -> Prometheus text exposition (0.0.4).
+
+    Counters render as ``counter``, gauges as ``gauge``, histograms as
+    ``summary`` (p50/p99 quantile samples + ``_sum``/``_count``).  The
+    always-on process counters ride along with labels; ``run_recompiles``
+    (jit cache misses SINCE the active run's baseline) is the live form of
+    the steady-state no-recompile invariant — 0 on a healthy serving
+    process."""
+    from .. import resilience
+    from ..utils.file_io import io_retry_count
+    from . import launches, recompile
+    lines = []
+
+    def metric(name, mtype, samples):
+        lines.append("# TYPE %s %s" % (name, mtype))
+        lines.extend(samples)
+
+    # registry counters that MIRROR an always-on process counter rendered
+    # below: emitting both would duplicate the metric name (invalid
+    # exposition — Prometheus fails the whole scrape); the labeled
+    # process-wide block is the richer one, so it wins
+    mirrored = ("recompiles", "tree_kernel_launches", "predict_fallbacks",
+                "io_retries")
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        if name in mirrored:
+            continue
+        n = _prom_name(name) + "_total"
+        metric(n, "counter", ["%s %s" % (n, _prom_val(v))])
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        n = _prom_name(name)
+        metric(n, "gauge", ["%s %s" % (n, _prom_val(v))])
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        n = _prom_name(name)
+        samples = []
+        for q in ("p50", "p99"):
+            if q in h:
+                samples.append('%s{quantile="0.%s"} %s'
+                               % (n, q[1:], _prom_val(h[q])))
+        samples.append("%s_sum %s" % (n, _prom_val(h.get("sum", 0.0))))
+        samples.append("%s_count %s" % (n, _prom_val(h.get("count", 0))))
+        metric(n, "summary", samples)
+    # always-on process counters (live without any telemetry run)
+    rc = _PREFIX + "recompiles_total"
+    metric(rc, "counter",
+           ['%s{fn="%s",bucket="%s"} %d' % (rc, _esc_label(f),
+                                            _esc_label(b), n)
+            for (f, b), n in sorted(recompile.counts().items())]
+           or ["%s 0" % rc])
+    if run_recompiles is not None:
+        rr = _PREFIX + "run_recompiles"
+        metric(rr, "gauge", ["%s %d" % (rr, int(run_recompiles))])
+    lc = _PREFIX + "tree_kernel_launches_total"
+    metric(lc, "counter",
+           ['%s{mode="%s"} %d' % (lc, _esc_label(m), n)
+            for m, n in sorted(launches.counts().items())]
+           or ["%s 0" % lc])
+    fb = _PREFIX + "predict_fallbacks_total"
+    metric(fb, "counter",
+           ['%s{site="%s"} %d' % (fb, _esc_label(s), n)
+            for s, n in sorted(resilience.fallback_counts().items())]
+           or ["%s 0" % fb])
+    io = _PREFIX + "io_retries_total"
+    metric(io, "counter", ["%s %d" % (io, io_retry_count())])
+    return "\n".join(lines) + "\n"
+
+
+def health_snapshot(tele=None) -> Dict[str, Any]:
+    """The /healthz body: one dict an operator (or a supervisor probe) can
+    alert on.  ``status`` is ``ok`` | ``draining`` (preemption requested or
+    a serving provider is closing — the process is shutting down cleanly)
+    | ``stalled`` (the dispatch watchdog fired)."""
+    from .. import resilience
+    from ..checkpoint import last_checkpoint_time
+    now = time.time()
+    out: Dict[str, Any] = {"ts": now}
+    preempt = resilience.preemption_requested()
+    out["preemption_requested"] = preempt
+    wd = resilience.watchdog_status()
+    out["watchdog"] = wd
+    stall = resilience.last_stall()
+    if stall is not None:
+        out["watchdog_stall"] = {"section": stall.get("section"),
+                                 "stall_s": stall.get("stall_s"),
+                                 "ts": stall.get("ts")}
+    ckpt_ts = last_checkpoint_time()
+    out["last_checkpoint_age_s"] = (round(now - ckpt_ts, 3)
+                                    if ckpt_ts else None)
+    with _plock:
+        provs = list(_providers.items())
+    draining = preempt
+    for name, fn in provs:
+        try:
+            info = fn()
+        except Exception as exc:  # a dying provider must not kill /healthz
+            info = {"error": str(exc)}
+        out[name] = info
+        if isinstance(info, dict):
+            draining = draining or bool(info.get("draining"))
+            if "queue_depth" in info and "queue_depth" not in out:
+                out["queue_depth"] = info["queue_depth"]
+    if tele is not None:
+        out["uptime_s"] = round(now - tele.started_at, 3)
+        out["events"] = tele.event_count
+        if getattr(tele, "rank", None) is not None:
+            out["rank"] = tele.rank
+    if stall is not None or (wd is not None and wd.get("fired")):
+        out["status"] = "stalled"
+    elif draining:
+        out["status"] = "draining"
+    else:
+        out["status"] = "ok"
+    return out
+
+
+class MetricsExporter:
+    """The /metrics + /healthz + /summary.json listener for one run.
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is on
+    ``self.port``.  All handlers are read-only snapshots; the server
+    thread pool (``ThreadingHTTPServer``) keeps a slow scraper from
+    serializing behind another."""
+
+    def __init__(self, tele, port: int = 0,
+                 addr: str = "127.0.0.1") -> None:
+        self.tele = tele
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no per-scrape stderr spam
+                pass
+
+            def _send(self, code, body: str, ctype: str) -> None:
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(200, exporter._metrics_text(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/healthz":
+                        health = health_snapshot(exporter.tele)
+                        code = 503 if health["status"] == "stalled" else 200
+                        self._send(code, json.dumps(health, default=str),
+                                   "application/json")
+                    elif path == "/summary.json":
+                        from .report import summarize
+                        self._send(200, json.dumps(
+                            summarize(exporter.tele), default=str),
+                            "application/json")
+                    else:
+                        self._send(404, "not found: %s\n" % path,
+                                   "text/plain")
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # scrape must never kill the run
+                    try:
+                        self._send(500, "%s: %s\n"
+                                   % (type(exc).__name__, exc),
+                                   "text/plain")
+                    except OSError:
+                        pass
+
+        self._server = ThreadingHTTPServer((addr, int(port)), Handler)
+        self._server.daemon_threads = True
+        self.addr = self._server.server_address[0]
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="lgbm-tpu-metrics", daemon=True)
+        self._thread.start()
+
+    def _metrics_text(self) -> str:
+        from . import recompile
+        snap = self.tele.registry.snapshot()
+        base = getattr(self.tele, "recompile_baseline", {})
+        run = sum(max(n - base.get(k, 0), 0)
+                  for k, n in recompile.counts().items())
+        return render_prometheus(snap, run_recompiles=run)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+
+def start_exporter(tele, port: int = 0,
+                   addr: str = "127.0.0.1") -> MetricsExporter:
+    """Start (or return the already-running) exporter for ``tele``; the
+    exporter is owned by the run — ``Telemetry.close()`` stops it."""
+    exp = getattr(tele, "exporter", None)
+    if exp is not None:
+        try:
+            # exp.addr is the RESOLVED bound address; normalize the
+            # request the same way so metrics_addr=localhost does not
+            # false-alarm against 127.0.0.1
+            import socket
+            req_addr = socket.gethostbyname(addr)
+        except OSError:
+            req_addr = addr
+        if int(port) not in (0, exp.port) or req_addr != exp.addr:
+            # a silent mismatch would leave the operator scraping a dead
+            # port with nothing in the logs explaining why
+            Log.warning("telemetry exporter already listening on "
+                        "http://%s:%d; ignoring request for %s:%d",
+                        exp.addr, exp.port, addr, int(port))
+        return exp
+    exp = MetricsExporter(tele, port=port, addr=addr)
+    tele.exporter = exp
+    Log.info("telemetry exporter listening on http://%s:%d "
+             "(/metrics /healthz /summary.json)", exp.addr, exp.port)
+    return exp

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import random
 import threading
 import time
@@ -74,7 +75,17 @@ class Gauge:
 class Histogram:
     """Value histogram with exact quantiles over a bounded sample buffer;
     observations are lock-protected (count/sum/samples must stay
-    consistent under concurrent predict threads)."""
+    consistent under concurrent predict threads).
+
+    Sample-buffer semantics past the cap: ``count``/``sum``/``min``/``max``
+    stay exact for EVERY observation, while the quantile buffer holds a
+    uniform reservoir (Vitter's Algorithm R) of ``HISTOGRAM_SAMPLE_CAP``
+    samples — each of the run's N observations ends resident with equal
+    probability cap/N, so ``p50``/``p99`` estimate the WHOLE run's
+    distribution, not its first 65k observations (a long-lived serving
+    process whose latency regime shifts after warmup keeps seeing the
+    shift in its quantiles).  Pinned by
+    tests/test_obs_plane.py::test_histogram_reservoir_covers_whole_run."""
 
     __slots__ = ("count", "sum", "min", "max", "_samples", "_lock")
 
@@ -191,31 +202,50 @@ def validate_event(obj: Dict[str, Any]) -> None:
             raise ValueError("event field %r is not a scalar: %r" % (k, v))
 
 
-def read_events(path: str) -> List[Dict[str, Any]]:
-    """Load + schema-validate a telemetry JSONL file.
+def iter_events(path: str):
+    """Stream + schema-validate a telemetry JSONL file, one event at a
+    time — O(1) memory, so a multi-GB died-run artifact never needs
+    artifact-sized RAM (``tools/obs_report.py`` consumes this).
 
     A torn FINAL line (the writer was killed mid-write — the artifact of a
     preempted run) is dropped with a warning instead of failing the read;
-    corruption anywhere else still raises."""
-    out = []
+    corruption anywhere else still raises.  Streaming keeps that contract
+    by holding each decode error back one line: if any later non-empty
+    line exists the error was mid-file and raises, otherwise it was the
+    torn tail and is dropped."""
     with open(path) as fh:
-        lines = fh.readlines()
-    for i, line in enumerate(lines):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            obj = json.loads(line)
-            validate_event(obj)
-        except (json.JSONDecodeError, ValueError) as exc:
-            if i == len(lines) - 1:
-                from ..utils.log import Log
-                Log.warning("%s: dropping torn final line (%s) — the "
-                            "writer was likely killed mid-event", path, exc)
-                break
-            raise ValueError("%s line %d: %s" % (path, i + 1, exc))
-        out.append(obj)
-    return out
+        pending: Optional[tuple] = None
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            if pending is not None:
+                raise ValueError("%s line %d: %s"
+                                 % (path, pending[0] + 1, pending[1]))
+            try:
+                obj = json.loads(line)
+                validate_event(obj)
+            except (json.JSONDecodeError, ValueError) as exc:
+                pending = (i, exc)
+                continue
+            yield obj
+        if pending is not None:
+            from ..utils.log import Log
+            Log.warning("%s: dropping torn final line (%s) — the "
+                        "writer was likely killed mid-event",
+                        path, pending[1])
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Load + schema-validate a telemetry JSONL file (the list form of
+    :func:`iter_events`, same torn-final-line recovery)."""
+    return list(iter_events(path))
+
+
+def shard_path(out: str, rank: int) -> str:
+    """Per-host JSONL sink path of pod rank ``rank`` for base path
+    ``out`` — ``tools/obs_report.py --merge`` globs these back together."""
+    return "%s.rank%d.jsonl" % (out, int(rank))
 
 
 class Telemetry:
@@ -224,15 +254,33 @@ class Telemetry:
     ``out`` is the JSONL path (None buffers events in memory only — tests,
     embedding hosts); ``freq`` is the per-iteration event cadence consumers
     like engine.train honor (record every ``freq``-th iteration).
+
+    ``rank`` is the pod process index (``obs.configure`` resolves it):
+    when set, every event is stamped with it so shard sinks from several
+    hosts can be merged into one causal pod view.  ``summary_base`` is the
+    UNsharded output base the leader's ``<base>.summary.json`` is named
+    from (equal to ``out`` outside pod mode).
     """
 
     def __init__(self, out: Optional[str] = None, freq: int = 1,
-                 meta: Optional[Dict[str, Any]] = None) -> None:
+                 meta: Optional[Dict[str, Any]] = None,
+                 rank: Optional[int] = None,
+                 summary_base: Optional[str] = None) -> None:
         import collections
+        import socket
 
         from ..utils.timer import global_timer
         self.registry = MetricsRegistry()
         self.out_path = out
+        self.summary_base = summary_base if summary_base is not None else out
+        self.rank = rank
+        self.host = socket.gethostname()
+        # run-level trace id: host-side spans (train_chunk, checkpoint
+        # writes) parent under it; serving requests open their own traces
+        self.trace_id = os.urandom(8).hex()
+        # the live scrape listener (obs/exporter.py) owned by this run;
+        # close() shuts it down with the run
+        self.exporter = None
         self.freq = max(int(freq), 1)
         # newest-EVENT_BUFFER_CAP mirror of the JSONL stream (the file is
         # the durable record); event_count is the total ever recorded
@@ -271,6 +319,10 @@ class Telemetry:
 
     def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
         obj = {"v": EVENT_SCHEMA_VERSION, "ts": time.time(), "kind": kind}
+        if self.rank is not None:
+            # pod runs stamp every event with the writing host's rank so a
+            # merged view keeps per-host attribution
+            obj["rank"] = self.rank
         obj.update(fields)
         # serialize OUTSIDE the lock (concurrent predict threads should
         # contend only on the append + ordered write, not on json.dumps)
@@ -302,6 +354,11 @@ class Telemetry:
                 self._fh.flush()
 
     def close(self) -> None:
+        # the exporter thread is stopped OUTSIDE the event lock (its
+        # in-flight handlers may be reading snapshots that briefly take it)
+        exp, self.exporter = self.exporter, None
+        if exp is not None:
+            exp.stop()
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()

@@ -177,6 +177,10 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
         "mfu": gauges.get("mfu"),
         "device_util": gauges.get("device_util"),
         "events": getattr(tele, "event_count", len(tele.events)),
+        # pod provenance: which host produced this summary (rank None =
+        # single-process run)
+        "rank": getattr(tele, "rank", None),
+        "host": getattr(tele, "host", None),
     }
     # serving rollup (lightgbm_tpu/serving): per-model qps/latency/occupancy
     # plus eviction/swap counts — present only when the run served traffic
@@ -305,7 +309,14 @@ def finalize_run(tele: Telemetry, gbdt=None, wall_s: Optional[float] = None,
     tele.event("run_end", wall_s=wall_s, iterations=iters)
     path = summary_path
     if path is None and tele.out_path:
-        path = tele.out_path + ".summary.json"
+        # the summary is named from the UNsharded base so the leader's
+        # <out>.summary.json sits next to every rank's shard
+        path = (getattr(tele, "summary_base", None)
+                or tele.out_path) + ".summary.json"
+    if path and getattr(tele, "rank", None) not in (None, 0):
+        # leader-only file discipline: non-leader ranks keep their shard
+        # JSONL but must not race d hosts over one summary path
+        path = None
     if path:
         from ..utils.file_io import atomic_write
         atomic_write(path, json.dumps(summary, indent=1, default=str))

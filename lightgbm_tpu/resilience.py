@@ -285,6 +285,19 @@ class Watchdog:
                 self._sections.pop(token, None)
                 self._completed.add(key)
 
+    def status(self) -> Dict[str, Any]:
+        """Live view for /healthz (obs/exporter.py): open dispatch
+        sections with the age of the oldest one, and whether this
+        watchdog already fired."""
+        now = time.monotonic()
+        with self._lock:
+            ages = [now - start for _, _, start, _ in
+                    self._sections.values()]
+        return {"active": True, "timeout_s": self.timeout_s,
+                "open_sections": len(ages),
+                "oldest_open_s": round(max(ages), 3) if ages else None,
+                "fired": self.fired is not None}
+
     # ---- monitor ----
 
     def _bar_s(self, key) -> float:
@@ -342,8 +355,10 @@ class Watchdog:
 
     def _handle_stall(self, name: str, elapsed: float,
                       info: Dict[str, Any]) -> None:
+        global _LAST_STALL
         diag = self._diagnostics(name, elapsed, info)
         self.fired = diag
+        _LAST_STALL = diag
         Log.warning("WATCHDOG: no progress in %r for %.1f s (timeout %.1f s)"
                     " — dumping diagnostics and aborting", name, elapsed,
                     self.timeout_s)
@@ -369,6 +384,23 @@ class Watchdog:
 
 _WATCHDOG: Optional[Watchdog] = None
 _NULL_CTX = contextlib.nullcontext()
+# the last stall diagnostic, surviving the (one-shot) watchdog teardown so
+# /healthz keeps reporting "stalled" after a non-aborting fire; cleared
+# when a fresh watchdog arms
+_LAST_STALL: Optional[Dict] = None
+
+
+def last_stall() -> Optional[Dict]:
+    """Diagnostics of the most recent watchdog stall (None when the
+    current supervision generation has seen none)."""
+    return _LAST_STALL
+
+
+def clear_stall() -> None:
+    """Drop the recorded stall evidence (tests; an embedding host that
+    recovered out-of-band).  Arming a fresh watchdog clears it too."""
+    global _LAST_STALL
+    _LAST_STALL = None
 
 
 def start_watchdog(timeout_s: float, artifact: Optional[str] = None,
@@ -377,7 +409,8 @@ def start_watchdog(timeout_s: float, artifact: Optional[str] = None,
                    first_dispatch_grace: float = FIRST_DISPATCH_GRACE
                    ) -> Watchdog:
     """Install (replacing any previous) the process-active watchdog."""
-    global _WATCHDOG
+    global _LAST_STALL, _WATCHDOG
+    _LAST_STALL = None  # fresh supervision generation, fresh evidence
     prev, _WATCHDOG = _WATCHDOG, Watchdog(
         timeout_s, artifact=artifact, abort=abort, on_stall=on_stall,
         first_dispatch_grace=first_dispatch_grace)
@@ -397,6 +430,13 @@ def stop_watchdog() -> None:
 
 def watchdog_active() -> Optional[Watchdog]:
     return _WATCHDOG
+
+
+def watchdog_status() -> Optional[Dict[str, Any]]:
+    """The active watchdog's :meth:`Watchdog.status` (None when no
+    watchdog is armed) — the /healthz heartbeat source."""
+    wd = _WATCHDOG
+    return wd.status() if wd is not None else None
 
 
 def watch(name: str, compile_key: Any = None, **info: Any):

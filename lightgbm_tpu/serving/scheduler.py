@@ -36,6 +36,7 @@ import numpy as np
 
 from ..core.predict_fused import PREDICT_BUCKETS, shape_bucket
 from ..obs import active as _telemetry_active
+from ..obs import spans as _spans
 from ..utils.log import LightGBMError, Log
 from .registry import DEFAULT_BUDGET_MB, ModelRegistry, _safe_name
 
@@ -63,7 +64,8 @@ class _BatchKey(NamedTuple):
 
 
 class _Request:
-    __slots__ = ("key", "rows", "n", "future", "t_submit", "fast", "taken")
+    __slots__ = ("key", "rows", "n", "future", "t_submit", "t_claim",
+                 "fast", "taken")
 
     def __init__(self, key: _BatchKey, rows: np.ndarray, fast: bool) -> None:
         self.key = key
@@ -71,6 +73,9 @@ class _Request:
         self.n = len(rows)
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        # stamped when the dispatcher claims the request: queue wait =
+        # t_claim - t_submit, the per-request span the telemetry renders
+        self.t_claim = self.t_submit
         self.fast = fast
         # claimed by the dispatcher (head pop or same-key absorption); the
         # OTHER structure's stale reference becomes a skipped tombstone
@@ -91,7 +96,9 @@ class Server:
                  single_row_fast: Optional[bool] = None,
                  residency_budget_mb: Optional[float] = None,
                  max_queue_depth: int = 0,
-                 owned_telemetry=None) -> None:
+                 owned_telemetry=None,
+                 metrics_port: Optional[int] = None,
+                 metrics_addr: Optional[str] = None) -> None:
         # a telemetry run THIS server owns (engine.serve opened it for us):
         # close() finalizes it into <telemetry_out>.summary.json and
         # releases the process-active slot, same ownership rule as
@@ -139,6 +146,34 @@ class Server:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="lgbm-tpu-serve")
         self._thread.start()
+        # live-plane wiring: queue depth / draining state feed /healthz
+        # (a dict write at construction, never hot-path work), and a
+        # metrics_port starts the exporter on the active run when the
+        # driver has not already
+        from ..obs import exporter as _exporter
+        self._health_key = _exporter.register_health_provider(
+            "serving", self._health_info)
+        try:
+            m_port = int(metrics_port if metrics_port is not None
+                         else _cfg("metrics_port", 0))
+            if m_port > 0:
+                tele = _telemetry_active()
+                if tele is not None:
+                    _exporter.start_exporter(
+                        tele, port=m_port,
+                        addr=str(metrics_addr
+                                 if metrics_addr is not None
+                                 else _cfg("metrics_addr", "127.0.0.1")))
+                else:
+                    Log.warning("metrics_port=%d set but no telemetry run "
+                                "is active; the exporter serves the active "
+                                "run — set telemetry_out (or obs.configure) "
+                                "to enable it", m_port)
+        except BaseException:
+            # a failed port bind must not leak the dispatcher thread or
+            # pin this half-built server in the /healthz provider registry
+            self.close(drain=False)
+            raise
 
     # ---- model management (delegates to the registry) ----
 
@@ -266,6 +301,7 @@ class Server:
             if req.taken:
                 continue
             req.taken = True
+            req.t_claim = time.perf_counter()
             self._queued -= 1
             self._inflight += 1
             return req
@@ -288,6 +324,7 @@ class Server:
                     else:
                         self._cond.wait()
                 first.taken = True
+                first.t_claim = time.perf_counter()
                 self._queued -= 1
                 self._inflight += 1
                 # drain the head's own tombstone (and older ones) from its
@@ -417,6 +454,39 @@ class Server:
                        fast=bool(fast), dt_s=done - t0,
                        lat_max_s=done - min(r.t_submit for r in batch),
                        queue_depth=int(depth))
+            # per-request spans: one trace per request, with its queue
+            # wait, coalescing hold and the shared dispatch as children —
+            # queue time is visible PER REQUEST, not just as lat_max_s.
+            # telemetry_freq doubles as the span sampling rate here (every
+            # Nth batch carries lifelines): 4 events per request from the
+            # single dispatcher thread would otherwise dominate the
+            # serving critical path at high qps.  perf_counter stamps
+            # anchor to the wall clock via one pair sampled per batch
+            # (spans only need relative alignment)
+            if tele.freq > 1 and self.batches % tele.freq:
+                return
+            wall, pc = time.time(), time.perf_counter()
+
+            def w(t: float) -> float:
+                return wall - (pc - t)
+
+            for req in batch:
+                tid = _spans.new_id()
+                root = _spans.record_span(
+                    tele, "serve_request", trace_id=tid,
+                    t0=w(req.t_submit), dur_s=done - req.t_submit,
+                    model=m, rows=int(req.n), fast=bool(fast))
+                _spans.record_span(
+                    tele, "queue_wait", trace_id=tid, parent_id=root,
+                    t0=w(req.t_submit),
+                    dur_s=max(req.t_claim - req.t_submit, 0.0))
+                _spans.record_span(
+                    tele, "coalesce", trace_id=tid, parent_id=root,
+                    t0=w(req.t_claim), dur_s=max(t0 - req.t_claim, 0.0))
+                _spans.record_span(
+                    tele, "dispatch", trace_id=tid, parent_id=root,
+                    t0=w(t0), dur_s=done - t0, rows=int(nrows),
+                    bucket=int(bucket))
 
     def _fail(self, batch, exc: Exception) -> None:
         if not batch:
@@ -439,6 +509,17 @@ class Server:
                        error="%s: %s" % (type(exc).__name__, exc))
 
     # ---- lifecycle / introspection ----
+
+    def _health_info(self) -> Dict[str, Any]:
+        """The /healthz "serving" block: queue + inflight counts and the
+        draining flag (set once close() stops intake)."""
+        with self._cond:
+            return {"queue_depth": self._queued,
+                    "inflight": self._inflight,
+                    "submitted": self.submitted,
+                    "completed": self.completed,
+                    "rejected": self.rejected,
+                    "draining": self._closed}
 
     def stats(self) -> Dict[str, Any]:
         with self._cond:
@@ -478,6 +559,9 @@ class Server:
                 self._by_key.clear()
             self._cond.notify_all()
         self._thread.join(timeout=timeout)
+        from ..obs import exporter as _exporter
+        _exporter.unregister_health_provider(self._health_key,
+                                             self._health_info)
         tele = _telemetry_active()
         if tele is not None and self._t_first is not None:
             end = self._t_last if self._t_last is not None \

@@ -337,7 +337,9 @@ def test_resumed_run_iterations_not_inflated(tmp_path):
 
 def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch, tmp_path):
     """With telemetry disabled (the default), a fused-scan training run and
-    a predict loop must record NOTHING: no events, no metric touches.
+    a predict loop must record NOTHING: no events, no metric touches, no
+    span allocations, no exporter listener thread (round 14 extends the
+    spy over obs/spans.py and obs/exporter.py).
     The resilience paths are held to the same contract: a degraded-predict
     fallback and a retried I/O fault are counted in their always-on module
     counters but make zero telemetry calls when no run is active."""
@@ -353,11 +355,38 @@ def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch, tmp_path):
 
     for name in ("event", "counter", "gauge", "histogram", "time_block"):
         monkeypatch.setattr(Telemetry, name, spy(name))
+    # span + exporter paths: zero Span constructions, zero record_span
+    # emissions, zero exporter starts with telemetry off
+    from lightgbm_tpu.obs import exporter as obs_exporter
+    from lightgbm_tpu.obs import spans as obs_spans
+    monkeypatch.setattr(
+        obs_spans, "record_span",
+        lambda *a, **k: calls.append(("record_span", a)))
+    monkeypatch.setattr(
+        obs_spans.Span, "__init__",
+        lambda self, *a, **k: calls.append(("Span", a)))
+    monkeypatch.setattr(
+        obs_exporter, "start_exporter",
+        lambda *a, **k: calls.append(("start_exporter", a)))
+    monkeypatch.setattr(
+        obs_exporter.MetricsExporter, "__init__",
+        lambda self, *a, **k: calls.append(("MetricsExporter", a)))
     assert obs.active() is None
     booster, X, _ = _toy_booster(num_iterations=8)
     booster.train_chunk(8)
     booster.predict(X[:600])
     booster.train(None)  # the driver path too
+    # a serving round trip (the span-instrumented scheduler) stays silent
+    # too, and no listener thread exists anywhere in the process
+    from lightgbm_tpu.serving import Server
+    with Server(max_batch_wait_us=0) as srv:
+        srv.register("spy", booster)
+        srv.predict("spy", X[:8])
+    assert not any(t.name == "lgbm-tpu-metrics"
+                   for t in threading.enumerate()), \
+        "exporter listener running with telemetry off"
+    with obs_spans.span("noop"):  # the off-path span is the nullcontext
+        pass
     # degraded predict: the fallback counter must not touch Telemetry
     import lightgbm_tpu.core.predict_fused as pf
     real_pb = pf.predict_blocked

@@ -43,6 +43,13 @@ Scenarios (each prints PASS/FAIL and exits nonzero on failure):
                mid-traffic.  Zero dropped requests (every response bit-exact
                vs the generation that served it), zero steady-state
                recompiles after warmup, old predictor entries fully dropped.
+  scrape-under-preempt  The round-14 live-plane drill: the SIGTERM
+               scenario with the HTTP exporter (obs/exporter.py) up.
+               /healthz reads "ok" mid-train and flips to "draining" the
+               moment the preemption flag lands (before the chunk-boundary
+               poll), /metrics stays well-formed Prometheus text, the
+               process exits 75, and the final summary artifact is
+               consistent with the last live /summary.json scrape.
   all          Run every scenario.
 
 ``--matrix`` runs every scenario, prints a pass/fail table, and writes a
@@ -312,6 +319,105 @@ def scenario_sigterm(workdir: str) -> None:
         "SIGTERM-preempted resume diverged from the uninterrupted run"
     print("PASS sigterm: exit code %d + emergency checkpoint at iter %d; "
           "resume is bit-exact" % (EXIT_PREEMPTED, resumed))
+
+
+# ---- scrape-under-preempt: live exporter through the SIGTERM drill ----
+
+_SCRAPE_CHILD_SRC = _TRAIN_SRC + r"""
+# the round-14 live-plane drill: a telemetry run with the HTTP exporter
+# up, scraped at three defined points — mid-train (healthy), right after
+# the SIGTERM flag is raised but before the chunk-boundary poll consumes
+# it (/healthz must already say draining), and right before the preempted
+# exit (/summary.json must match what finalize writes to disk).
+import json as _json
+import signal
+import urllib.request
+from lightgbm_tpu import obs, resilience
+from lightgbm_tpu.obs.exporter import start_exporter
+
+resilience.install_preemption_handler()
+tele = obs.configure(out=os.environ["TELEMETRY_OUT"], freq=1,
+                     entry="scrape-drill")
+exp = start_exporter(tele, port=0)  # ephemeral; the child self-scrapes
+base = "http://127.0.0.1:%d" % exp.port
+
+def get(path):
+    return urllib.request.urlopen(base + path, timeout=10).read().decode()
+
+booster = build(int(os.environ["TOTAL_ITERS"]), int(os.environ["SNAP_FREQ"]))
+orig_chunk = booster.train_chunk
+state = {"n": 0}
+scrapes = {}
+
+def chunk(k):
+    r = orig_chunk(k)
+    state["n"] += 1
+    if state["n"] == 1:
+        scrapes["healthz_mid"] = get("/healthz")
+        scrapes["metrics_mid"] = get("/metrics")
+    if state["n"] == 2:
+        signal.raise_signal(signal.SIGTERM)
+        # flag set, not yet polled: the NEXT boundary drains — the live
+        # plane must already report it
+        scrapes["healthz_draining"] = get("/healthz")
+    return r
+
+booster.train_chunk = chunk
+try:
+    booster.train(snapshot_out=os.environ["MODEL_OUT"])
+except resilience.TrainingPreempted as exc:
+    scrapes["summary_final"] = get("/summary.json")
+    with open(os.environ["SCRAPES_OUT"], "w") as fh:
+        _json.dump(scrapes, fh)
+    from lightgbm_tpu.obs.report import finalize_run
+    finalize_run(tele)
+    obs.disable()
+    print("PREEMPTED iter=%d" % exc.iteration)
+    sys.exit(resilience.EXIT_PREEMPTED)
+print("TRAINED-TO-END")
+"""
+
+
+def scenario_scrape_under_preempt(workdir: str) -> None:
+    """SIGTERM drill with a live exporter: /healthz flips ok -> draining
+    when the flag lands, /metrics stays well-formed Prometheus text, exit
+    code is 75, and the final on-disk summary is consistent with the last
+    live scrape."""
+    from lightgbm_tpu.resilience import EXIT_PREEMPTED
+    out = os.path.join(workdir, "model_scrape.txt")
+    t_out = os.path.join(workdir, "scrape_drill.jsonl")
+    scrapes_out = os.path.join(workdir, "scrapes.json")
+    p = _run_child(_SCRAPE_CHILD_SRC, {
+        "MODEL_OUT": out, "TOTAL_ITERS": "20", "SNAP_FREQ": "7",
+        "TELEMETRY_OUT": t_out, "SCRAPES_OUT": scrapes_out})
+    assert p.returncode == EXIT_PREEMPTED, \
+        "expected exit %d (resumable), got %r: %s" % (
+            EXIT_PREEMPTED, p.returncode, p.stdout + p.stderr[-2000:])
+    with open(scrapes_out) as fh:
+        scrapes = json.load(fh)
+    healthy = json.loads(scrapes["healthz_mid"])
+    assert healthy["status"] == "ok", healthy
+    draining = json.loads(scrapes["healthz_draining"])
+    assert draining["status"] == "draining", draining
+    assert draining["preemption_requested"] is True, draining
+    metrics = scrapes["metrics_mid"]
+    assert "# TYPE lgbm_tpu_" in metrics, metrics[:200]
+    assert "lgbm_tpu_run_recompiles" in metrics, metrics[:200]
+    assert "lgbm_tpu_chunk_dispatch_s_count" in metrics, metrics[:400]
+    # the last live scrape and the finalized artifact describe the SAME
+    # run state: no chunks trained between them, preemption counted
+    live = json.loads(scrapes["summary_final"])
+    with open(t_out + ".summary.json") as fh:
+        final = json.load(fh)
+    live_chunks = live["histograms"]["chunk_dispatch_s"]["count"]
+    final_chunks = final["histograms"]["chunk_dispatch_s"]["count"]
+    assert live_chunks == final_chunks, (live_chunks, final_chunks)
+    assert final["resilience"]["preemptions"] == 1, final["resilience"]
+    assert live["resilience"]["preemptions"] == 1, live["resilience"]
+    print("PASS scrape-under-preempt: /healthz ok -> draining at the "
+          "SIGTERM flag, well-formed /metrics mid-train, exit %d, final "
+          "summary consistent with the last scrape (%d chunks)"
+          % (EXIT_PREEMPTED, final_chunks))
 
 
 # ---- hang: stalled dispatch -> watchdog abort + diagnostic artifact ----
@@ -667,6 +773,7 @@ def scenario_swap_under_load(workdir: str) -> None:
 SCENARIOS = {"kill-write": scenario_kill_write,
              "swap-under-load": scenario_swap_under_load,
              "level-preempt": scenario_level_preempt,
+             "scrape-under-preempt": scenario_scrape_under_preempt,
              "corrupt": scenario_corrupt,
              "nan-grad": scenario_nan_grad,
              "sigterm": scenario_sigterm,

@@ -3,21 +3,32 @@
 
 Any run with ``telemetry_out=<path>`` set (engine.train, the CLI,
 bench.py) writes a schema-versioned JSONL event stream plus
-``<path>.summary.json``.  This tool turns those into things people read:
+``<path>.summary.json``; a pod run writes one ``<path>.rank<k>.jsonl``
+shard per host.  This tool turns those into things people read:
 
 - the end-of-run human table (``obs.report.human_table``) — from the
   written summary when present, else rebuilt from the events;
-- a Chrome-trace/Perfetto JSON (``--trace out.json``): every event
-  carrying a duration (``dt_s``) becomes a complete ("X") slice anchored
-  at its start timestamp, everything else an instant event — load it in
-  ``chrome://tracing`` / https://ui.perfetto.dev to see the host
-  dispatch timeline (fused chunks, predict buckets, checkpoint writes)
-  of a production run.
+- a Chrome-trace/Perfetto JSON (``--trace out.json``): ``kind="span"``
+  events (obs/spans.py) become nested lifelines — one lane per trace id,
+  so a single serving request shows its queue-wait / coalesce / dispatch
+  children inside the request slice — and every other event carrying a
+  duration (``dt_s``) becomes a complete ("X") slice, the rest instants.
+  Load in ``chrome://tracing`` / https://ui.perfetto.dev;
+- ``--merge``: treat the positional path as the pod BASE path, glob its
+  ``.rank<k>.jsonl`` shards, and reassemble the pod view of a (possibly
+  died) run: a per-host breakdown table plus, with ``--trace``, one
+  skew-aligned merged trace (each rank its own pid; per-rank timestamps
+  shifted so every rank's ``run_start`` coincides, removing host clock
+  skew from the picture).
+
+Events stream through ``obs.iter_events`` (O(1) memory), so a multi-GB
+died-run artifact never needs artifact-sized RAM.
 
 No device work, no import-time allocation: heavy imports happen inside
 ``main`` after argparse has answered ``--help``.
 """
 import argparse
+import glob
 import json
 import os
 import sys
@@ -25,12 +36,19 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# span bookkeeping fields that should not repeat into trace args
+_SPAN_KEYS = ("v", "ts", "kind", "dt_s", "t0", "dur_s", "name",
+              "trace_id", "span_id", "parent_id")
+
 
 def build_parser():
     ap = argparse.ArgumentParser(
         description="render a lightgbm_tpu telemetry JSONL into the human "
-                    "summary table and/or a Chrome-trace file")
-    ap.add_argument("jsonl", help="telemetry JSONL path (telemetry_out=...)")
+                    "summary table and/or a Chrome-trace file; --merge "
+                    "reassembles a pod run's .rank<k>.jsonl shards")
+    ap.add_argument("jsonl", help="telemetry JSONL path (telemetry_out=...);"
+                                  " with --merge, the pod BASE path the "
+                                  ".rank<k>.jsonl shards were derived from")
     ap.add_argument("--summary", default=None,
                     help="summary JSON to render (default: <jsonl>"
                          ".summary.json when present, else rebuilt from "
@@ -38,39 +56,86 @@ def build_parser():
     ap.add_argument("--trace", default=None, metavar="OUT",
                     help="write a Chrome-trace/Perfetto JSON built from "
                          "the event timestamps to OUT")
+    ap.add_argument("--merge", action="store_true",
+                    help="pod mode: glob <jsonl>.rank*.jsonl shards, print "
+                         "a per-host breakdown and merge the trace "
+                         "(per-rank pids, run_start skew-aligned)")
     ap.add_argument("--no-table", action="store_true",
                     help="skip printing the human summary table")
     return ap
 
 
-def events_to_chrome_trace(events):
-    """Telemetry events -> Chrome trace-event JSON (ts/dur in microseconds).
+class _SpanLanes:
+    """Stable trace_id -> small-int lane assignment.  Lane 0 is reserved
+    for non-span events; each trace gets its own tid so its spans nest as
+    one lifeline in the viewer."""
 
-    Events with a ``dt_s`` field become complete slices anchored at their
-    recorded start (``t0`` when present, else ``ts - dt_s``); the rest are
-    instant events.  Scalar payload fields ride along as args."""
-    out = []
-    for e in events:
-        args = {k: v for k, v in e.items()
-                if k not in ("v", "ts", "kind", "dt_s", "t0")
-                and isinstance(v, (int, float, str, bool))}
-        dt = e.get("dt_s")
-        if isinstance(dt, (int, float)) and dt >= 0:
-            t0 = e.get("t0")
-            if not isinstance(t0, (int, float)):
-                t0 = e["ts"] - dt
-            out.append({"name": e["kind"], "ph": "X", "ts": t0 * 1e6,
-                        "dur": dt * 1e6, "pid": 0, "tid": 0, "args": args})
-        else:
-            out.append({"name": e["kind"], "ph": "i", "s": "g",
-                        "ts": e["ts"] * 1e6, "pid": 0, "tid": 0,
-                        "args": args})
-    return {"traceEvents": out, "displayTimeUnit": "ms"}
+    def __init__(self):
+        self._lanes = {}
+
+    def tid(self, trace_id) -> int:
+        lane = self._lanes.get(trace_id)
+        if lane is None:
+            lane = self._lanes[trace_id] = len(self._lanes) + 1
+        return lane
+
+
+def event_to_trace(e, lanes: _SpanLanes, shift: float = 0.0, pid: int = 0):
+    """One telemetry event -> one Chrome trace-event dict (ts/dur in
+    microseconds).  ``shift`` is added to every timestamp (skew
+    alignment); ``pid`` separates pod ranks."""
+    args = {k: v for k, v in e.items()
+            if k not in _SPAN_KEYS and isinstance(v, (int, float, str, bool))}
+    if e["kind"] == "span":
+        t0 = e.get("t0")
+        if not isinstance(t0, (int, float)):
+            t0 = e["ts"] - float(e.get("dur_s", 0.0))
+        return {"name": str(e.get("name", "span")), "ph": "X",
+                "ts": (t0 + shift) * 1e6,
+                "dur": float(e.get("dur_s", 0.0)) * 1e6,
+                "pid": pid, "tid": lanes.tid(e.get("trace_id")),
+                "args": args}
+    dt = e.get("dt_s")
+    if isinstance(dt, (int, float)) and dt >= 0:
+        t0 = e.get("t0")
+        if not isinstance(t0, (int, float)):
+            t0 = e["ts"] - dt
+        return {"name": e["kind"], "ph": "X", "ts": (t0 + shift) * 1e6,
+                "dur": dt * 1e6, "pid": pid, "tid": 0, "args": args}
+    return {"name": e["kind"], "ph": "i", "s": "g",
+            "ts": (e["ts"] + shift) * 1e6, "pid": pid, "tid": 0,
+            "args": args}
+
+
+def write_chrome_trace(out_path: str, shards) -> int:
+    """Stream shards of (pid, shift, event-iterable, label) into ONE
+    Chrome-trace JSON without materializing the events; returns the trace
+    event count.  Ordering is irrelevant to the format, so merging is a
+    plain concatenation."""
+    n = 0
+    with open(out_path, "w") as fh:
+        fh.write('{"displayTimeUnit": "ms", "traceEvents": [\n')
+        first = True
+        for pid, shift, events, label in shards:
+            if label is not None:
+                meta = {"name": "process_name", "ph": "M", "pid": pid,
+                        "args": {"name": label}}
+                fh.write(("" if first else ",\n") + json.dumps(meta))
+                first = False
+            lanes = _SpanLanes()
+            for e in events:
+                fh.write(("" if first else ",\n")
+                         + json.dumps(event_to_trace(e, lanes, shift, pid)))
+                first = False
+                n += 1
+        fh.write("\n]}\n")
+    return n
 
 
 def summary_from_events(events):
     """Rebuild a renderable summary dict from raw events (for JSONL files
-    whose run died before finalize_run wrote the summary)."""
+    whose run died before finalize_run wrote the summary).  ``events`` may
+    be any iterable — one streaming pass."""
     from lightgbm_tpu.obs.registry import Histogram
     hists = {}
     counters = {}
@@ -89,11 +154,18 @@ def summary_from_events(events):
                  "watchdog_stall": "watchdog_stalls",
                  "elastic_resume": "elastic_resumes"}
     resilience = {}
+    n_events = 0
     for e in events:
+        n_events += 1
         counters[e["kind"]] = counters.get(e["kind"], 0) + 1
         dt = e.get("dt_s")
         if isinstance(dt, (int, float)):
             hists.setdefault(e["kind"] + "_s", Histogram()).observe(dt)
+        if e["kind"] == "span" and isinstance(e.get("dur_s"), (int, float)):
+            # spans histogram under their own name so a died run still
+            # shows queue_wait/dispatch quantiles per span kind
+            hists.setdefault("span_%s_s" % e.get("name", "?"),
+                             Histogram()).observe(e["dur_s"])
         if e["kind"] in res_kinds:
             key = res_kinds[e["kind"]]
             resilience[key] = resilience.get(key, 0) + 1
@@ -163,19 +235,106 @@ def summary_from_events(events):
         "histograms": {k: h.summary() for k, h in sorted(hists.items())},
         "counters": {"events_" + k: v for k, v in sorted(counters.items())},
         "host_phases": {}, "gauges": {},
-        "mfu": None, "device_util": None, "events": len(events),
+        "mfu": None, "device_util": None, "events": n_events,
     }
+
+
+# ---- pod merge (--merge) ----
+
+def find_shards(base: str):
+    """[(rank, path)] for every ``<base>.rank<k>.jsonl`` shard, plus the
+    unsharded base file itself (rank 0) when present — a run that started
+    single-host and was resumed as a pod keeps both readable."""
+    shards = []
+    if os.path.exists(base):
+        shards.append((0, base))
+    for p in glob.glob(glob.escape(base) + ".rank*.jsonl"):
+        tail = p[len(base) + len(".rank"):-len(".jsonl")]
+        try:
+            shards.append((int(tail), p))
+        except ValueError:
+            continue
+    return sorted(shards)
+
+
+def _shard_scan(path: str):
+    """One streaming pass over a shard: (run_start ts or first ts, last
+    ts, event count, span count, per-kind counts)."""
+    from lightgbm_tpu.obs.registry import iter_events
+    start = last = None
+    n = spans = 0
+    kinds = {}
+    for e in iter_events(path):
+        if start is None or e["kind"] == "run_start":
+            start = e["ts"]
+        last = e["ts"]
+        n += 1
+        if e["kind"] == "span":
+            spans += 1
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    return start, last, n, spans, kinds
+
+
+def merge_report(base: str, trace_out=None, table=True) -> int:
+    """The pod view: per-host breakdown (+ merged summary table) and the
+    skew-aligned merged trace.  Returns 0, or 2 when no shards exist.
+
+    Scans and trace pids are keyed by FILE, not rank: the unsharded base
+    and a ``.rank0.jsonl`` shard can coexist (a run that started
+    single-host and resumed as a pod), and they must not collide into one
+    row/pid."""
+    from lightgbm_tpu.obs.registry import iter_events
+    from lightgbm_tpu.obs.report import human_table
+    shards = find_shards(base)
+    if not shards:
+        print("no shards found for base %r (expected %s.rank<k>.jsonl)"
+              % (base, base), file=sys.stderr)
+        return 2
+    # one entry per file: (pid, label, rank, path, scan)
+    entries = []
+    for pid, (rank, path) in enumerate(shards):
+        label = ("base (unsharded)" if path == base else "rank %d" % rank)
+        entries.append((pid, label, rank, path, _shard_scan(path)))
+    starts = [e[4][0] for e in entries if e[4][0] is not None]
+    t0 = min(starts) if starts else 0.0
+    print("pod view: %d shard(s) for %s" % (len(entries), base))
+    print("  %-16s %-8s %-7s %-10s %-10s %s"
+          % ("shard", "events", "spans", "start+s", "wall_s", "file"))
+    for pid, label, rank, path, (start, last, n, spans, _) in entries:
+        print("  %-16s %-8d %-7d %-10s %-10s %s"
+              % (label, n, spans,
+                 "-" if start is None else "%.3f" % (start - t0),
+                 "-" if start is None or last is None
+                 else "%.3f" % (last - start),
+                 os.path.basename(path)))
+    if table:
+        def all_events():
+            for _, _, _, path, _ in entries:
+                for e in iter_events(path):
+                    yield e
+        print(human_table(summary_from_events(all_events())))
+    if trace_out:
+        n = write_chrome_trace(trace_out, (
+            (pid, (t0 - scan[0]) if scan[0] else 0.0,
+             iter_events(path), label)
+            for pid, label, _, path, scan in entries))
+        print("wrote %s (%d trace events, %d shards, run_start "
+              "skew-aligned)" % (trace_out, n, len(entries)),
+              file=sys.stderr)
+    return 0
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    from lightgbm_tpu.obs.registry import read_events
+    from lightgbm_tpu.obs.registry import iter_events
     from lightgbm_tpu.obs.report import human_table
-    events = read_events(args.jsonl)
+    if args.merge:
+        return merge_report(args.jsonl, trace_out=args.trace,
+                            table=not args.no_table)
     if args.trace:
-        with open(args.trace, "w") as fh:
-            json.dump(events_to_chrome_trace(events), fh)
-        print("wrote %s (%d trace events)" % (args.trace, len(events)),
+        n = write_chrome_trace(
+            args.trace, [(0, 0.0, iter_events(args.jsonl), None)])
+        print("wrote %s (%d trace events)" % (args.trace, n),
               file=sys.stderr)
     if not args.no_table:
         summary_path = args.summary
@@ -186,7 +345,7 @@ def main(argv=None):
             with open(summary_path) as fh:
                 summary = json.load(fh)
         else:
-            summary = summary_from_events(events)
+            summary = summary_from_events(iter_events(args.jsonl))
         print(human_table(summary))
     return 0
 

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Perf gate: compare telemetry/BENCH artifacts against declared budgets.
+
+The repo's perf invariants lived in prose (PERF.md) and in eyeballs; this
+tool makes them a gate a CI step (or an operator after a hardware pass)
+can run::
+
+    python tools/perf_gate.py                       # committed artifacts
+    python tools/perf_gate.py out.jsonl.summary.json BENCH_serve.json
+
+``PERF_BUDGETS.json`` (repo root; ``--budgets`` overrides) declares the
+budgets:
+
+- ``recompiles_steady == 0`` — the steady-state no-recompile invariant,
+  checked on bench/serve artifacts that carry the gauge and on telemetry
+  summaries recorded after warmup;
+- ``serving_dropped == 0`` / ``serving_rejected_max`` /
+  ``serving_failed_max`` — the serving tier's never-drop contract;
+- level-mode launch structure — ``launches/tree <= depth * classes``
+  (and strictly fewer than leaf-wise) on split-cost artifacts;
+- regression factors (``serve_p99_regression``,
+  ``ns_per_row_p50_regression``) vs the committed baseline artifacts named
+  under ``baselines`` — a new artifact may not be worse than baseline by
+  more than the factor.
+
+Artifact type is sniffed from its keys (telemetry summary / bench-serve
+grid / split-cost / bench.py wrapper), so one invocation can gate a mixed
+pile.  Exit status: 0 all pass, 1 any breach, 2 unreadable input.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BUDGETS = os.path.join(REPO, "PERF_BUDGETS.json")
+
+
+class Gate:
+    """Collects per-check verdicts; one artifact may yield several."""
+
+    def __init__(self):
+        self.failures = 0
+        self.checks = 0
+
+    def check(self, artifact: str, name: str, ok: bool, detail: str) -> None:
+        self.checks += 1
+        if not ok:
+            self.failures += 1
+        print("%s %s: %s (%s)" % ("PASS" if ok else "FAIL",
+                                  os.path.basename(artifact), name, detail))
+
+    def skip(self, artifact: str, name: str, why: str) -> None:
+        print("SKIP %s: %s (%s)" % (os.path.basename(artifact), name, why))
+
+
+def _load(path: str):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _baseline(budgets_path: str, budgets: dict, key: str):
+    rel = (budgets.get("baselines") or {}).get(key)
+    if not rel:
+        return None, None
+    path = os.path.join(os.path.dirname(os.path.abspath(budgets_path)), rel)
+    if not os.path.exists(path):
+        return None, path
+    return _load(path), path
+
+
+def sniff(doc: dict) -> str:
+    """Artifact type from its keys."""
+    if not isinstance(doc, dict):
+        return "unknown"
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        return "bench_wrapper"
+    if doc.get("metric") == "telemetry_run":
+        return "summary"
+    if "grid" in doc and "dropped" in doc:
+        return "serve"
+    if "level" in doc or ("points" in doc and "fits" in doc):
+        return "split_cost"
+    if "metric" in doc and "value" in doc:
+        return "bench_line"
+    return "unknown"
+
+
+def gate_serve(g: Gate, path: str, doc: dict, b: dict, baseline) -> None:
+    g.check(path, "serving dropped", int(doc.get("dropped", 0))
+            <= int(b.get("serving_dropped", 0)),
+            "dropped=%s" % doc.get("dropped"))
+    g.check(path, "serving rejected", int(doc.get("rejected", 0))
+            <= int(b.get("serving_rejected_max", 0)),
+            "rejected=%s" % doc.get("rejected"))
+    if "recompiles_steady" in doc:
+        g.check(path, "recompiles steady",
+                int(doc["recompiles_steady"])
+                <= int(b.get("recompiles_steady", 0)),
+                "recompiles_steady=%s" % doc["recompiles_steady"])
+    factor = b.get("serve_p99_regression")
+    if factor and baseline and baseline.get("value"):
+        worst = float(doc.get("value", 0.0))
+        base = float(baseline["value"])
+        g.check(path, "serve p99 regression",
+                worst <= base * float(factor),
+                "worst p99 %.4gs vs baseline %.4gs (bar %.4gs = %.2fx)"
+                % (worst, base, base * float(factor), float(factor)))
+    elif factor:
+        g.skip(path, "serve p99 regression", "no serve baseline artifact")
+
+
+def gate_split_cost(g: Gate, path: str, doc: dict, b: dict) -> None:
+    lvl = doc.get("level")
+    if not lvl:
+        g.skip(path, "level launch structure", "no level block")
+        return
+    per_tree = (lvl.get("launches_per_tree") or {})
+    level = per_tree.get("level")
+    leaf = per_tree.get("leaf")
+    depth = lvl.get("depth")
+    classes = lvl.get("bucket_classes")
+    if level is None or depth is None or classes is None:
+        g.skip(path, "level launch structure", "level block incomplete")
+    else:
+        bound = float(depth) * float(classes)
+        g.check(path, "level launches/tree <= depth*classes",
+                float(level) <= bound,
+                "%.1f <= %d*%d" % (float(level), depth, classes))
+        if leaf is not None:
+            g.check(path, "level launches/tree < leaf-wise",
+                    float(level) < float(leaf),
+                    "%.1f < %.1f" % (float(level), float(leaf)))
+    amort = lvl.get("intercept_amortization")
+    bar = b.get("level_intercept_amortization_min")
+    if amort is not None and bar is not None:
+        g.check(path, "level intercept amortization",
+                float(amort) >= float(bar),
+                "%.2fx >= %.2fx" % (float(amort), float(bar)))
+
+
+def gate_bench_line(g: Gate, path: str, doc: dict, b: dict) -> None:
+    if "recompiles_steady" in doc:
+        g.check(path, "recompiles steady",
+                int(doc["recompiles_steady"])
+                <= int(b.get("recompiles_steady", 0)),
+                "recompiles_steady=%s" % doc["recompiles_steady"])
+    else:
+        g.skip(path, "recompiles steady", "gauge not in artifact")
+
+
+def gate_summary(g: Gate, path: str, doc: dict, b: dict,
+                 baseline_summary) -> None:
+    gauges = doc.get("gauges") or {}
+    # bench self-recording runs carry the timed-window gauge; plain runs
+    # include warmup compiles, where a zero bar would be meaningless
+    if gauges.get("recompiles_timed_window") is not None:
+        g.check(path, "recompiles steady",
+                int(gauges["recompiles_timed_window"])
+                <= int(b.get("recompiles_steady", 0)),
+                "recompiles_timed_window=%s"
+                % gauges["recompiles_timed_window"])
+    res = doc.get("resilience") or {}
+    if res.get("watchdog_stall_s") is not None:
+        g.check(path, "no watchdog stall", False,
+                "watchdog_stall_s=%s" % res["watchdog_stall_s"])
+    srv = doc.get("serving")
+    if srv:
+        g.check(path, "serving failed", int(srv.get("failed", 0))
+                <= int(b.get("serving_failed_max", 0)),
+                "failed=%s" % srv.get("failed", 0))
+        g.check(path, "serving rejected", int(srv.get("rejected", 0))
+                <= int(b.get("serving_rejected_max", 0)),
+                "rejected=%s" % srv.get("rejected", 0))
+    factor = b.get("ns_per_row_p50_regression")
+    cur = ((doc.get("ns_per_row") or {}).get("p50"))
+    base = ((baseline_summary or {}).get("ns_per_row") or {}).get("p50") \
+        if baseline_summary else None
+    if factor and cur is not None and base:
+        g.check(path, "ns/row p50 regression",
+                float(cur) <= float(base) * float(factor),
+                "%.4g vs baseline %.4g (%.2fx bar)"
+                % (float(cur), float(base), float(factor)))
+    elif factor and cur is not None:
+        g.skip(path, "ns/row p50 regression", "no telemetry baseline")
+
+
+def run_gate(artifacts, budgets_path: str) -> int:
+    try:
+        spec = _load(budgets_path)
+    except (OSError, ValueError) as exc:
+        print("cannot read budgets %s: %s" % (budgets_path, exc),
+              file=sys.stderr)
+        return 2
+    b = spec.get("budgets") or {}
+    serve_baseline, _ = _baseline(budgets_path, spec, "serve")
+    tele_baseline, _ = _baseline(budgets_path, spec, "telemetry")
+    if not artifacts:
+        # default: gate the committed baseline artifacts themselves (the
+        # self-consistency run CI uses)
+        artifacts = [p for _, p in
+                     ((_k, os.path.join(os.path.dirname(
+                         os.path.abspath(budgets_path)), rel))
+                      for _k, rel in (spec.get("baselines") or {}).items())
+                     if os.path.exists(p)]
+        if not artifacts:
+            print("no artifacts given and no baselines exist",
+                  file=sys.stderr)
+            return 2
+    g = Gate()
+    rc = 0
+    for path in artifacts:
+        try:
+            doc = _load(path)
+        except (OSError, ValueError) as exc:
+            print("cannot read artifact %s: %s" % (path, exc),
+                  file=sys.stderr)
+            rc = 2
+            continue
+        kind = sniff(doc)
+        if kind == "bench_wrapper":
+            doc, kind = doc["parsed"], sniff(doc["parsed"])
+        if kind == "serve":
+            gate_serve(g, path, doc, b, serve_baseline)
+        elif kind == "split_cost":
+            gate_split_cost(g, path, doc, b)
+        elif kind == "summary":
+            gate_summary(g, path, doc, b, tele_baseline)
+        elif kind == "bench_line":
+            gate_bench_line(g, path, doc, b)
+        else:
+            print("cannot identify artifact %s (keys: %s)"
+                  % (path, sorted(doc)[:8] if isinstance(doc, dict)
+                     else type(doc).__name__), file=sys.stderr)
+            rc = 2
+    print("perf gate: %d checks, %d failed" % (g.checks, g.failures))
+    if g.failures:
+        return 1
+    return rc
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(
+        description="gate telemetry summaries / BENCH artifacts against "
+                    "the declared perf budgets (PERF_BUDGETS.json); "
+                    "nonzero exit on any breach")
+    ap.add_argument("artifacts", nargs="*",
+                    help="artifact JSON paths (telemetry .summary.json, "
+                         "BENCH_serve, BENCH_split_cost, bench.py output); "
+                         "default: the budgets' committed baselines")
+    ap.add_argument("--budgets", default=DEFAULT_BUDGETS,
+                    help="budgets spec (default: repo PERF_BUDGETS.json)")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return run_gate(args.artifacts, args.budgets)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
