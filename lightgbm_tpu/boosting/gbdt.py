@@ -226,6 +226,12 @@ class GBDT:
         self.valid_sets: List[dict] = []
         self.train_metrics: List[Metric] = []
         self._loaded_params: Dict[str, str] = {}
+        # quality-plane provenance (obs/quality.py): when this booster last
+        # trained an iteration, plus cached score fingerprints / baseline
+        self.trained_at: Optional[float] = None
+        self._score_fingerprint_raw = None
+        self._score_fingerprint_out = None
+        self._quality_baseline_cache = None
         if train_data is not None:
             self.reset_training_data(train_data, objective)
 
@@ -648,6 +654,9 @@ class GBDT:
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training cannot continue (no splittable leaves)."""
+        # freshness provenance for the quality plane (obs/quality.py):
+        # seconds_behind gauges measure from the last trained iteration
+        self.trained_at = time.time()
         use_lazy = (self.lazy_trees
                     and not (self.objective is not None
                              and self.objective.is_renew_tree_output))
@@ -986,6 +995,7 @@ class GBDT:
         True when training stopped (no more splittable leaves)."""
         if num_iters <= 0:
             return False
+        self.trained_at = time.time()  # quality-plane freshness provenance
         # pre-chunk state refs for the per-chunk non-finite rollback; jax
         # arrays are immutable so holding them costs nothing
         self._prechunk = (self.train_score,
@@ -2029,6 +2039,35 @@ class GBDT:
         cols = [self.models[i].predict_leaf_index(X) for i in range(end * K)]
         return np.stack(cols, axis=1) if cols else np.zeros((len(X), 0), np.int32)
 
+    # ---- quality plane (obs/quality.py) ----
+
+    def quality_baseline(self, layout_ds=None):
+        """Drift baseline of THIS model against ``layout_ds`` (default: the
+        training data): per-feature training bin occupancy + importance +
+        score fingerprints.  Cached per (layout, model generation) — a
+        refit or swap rebuilds, steady serving reuses.  None when no
+        layout dataset is at hand (a model loaded without its dataset
+        serves fine but cannot be drift-scored)."""
+        from ..obs.quality import QualityBaseline, capture_fingerprints
+        ds = layout_ds if layout_ds is not None else self.train_data
+        if ds is None:
+            return None
+        # the cache HOLDS the layout dataset: an id()-only key could be
+        # recycled by a new dataset allocated at a freed one's address
+        key = (len(self._models), getattr(self, "_model_gen", 0))
+        cached = self._quality_baseline_cache
+        if cached is not None and cached[0] is ds and cached[1] == key:
+            return cached[2]
+        if (self._score_fingerprint_raw is None
+                and getattr(self, "train_score", None) is not None):
+            # captured HERE, on the first baseline build, not at train
+            # end: a telemetry-off training run must not pay the O(n)
+            # score-quantile pass for a fingerprint nothing will read
+            capture_fingerprints(self)
+        base = QualityBaseline.from_model(self, ds)
+        self._quality_baseline_cache = (ds, key, base)
+        return base
+
     # ---- binned fast path (core/predict_fused.py): training-format u8 rows ----
 
     def raw_predict_binned(self, dataset: Optional[BinnedDataset] = None,
@@ -2061,6 +2100,26 @@ class GBDT:
                                          k, kind="binned", layout_ds=layout)
             out[k] = pred(ds.binned, early_stop_margin=margin,
                           round_period=freq)
+        # quality plane: fold this EXTERNAL dataset's bin ids into the
+        # drift counters (training-data replays — dataset None / the train
+        # set itself — are by definition drift-free and stay out).  Gated
+        # on an active telemetry run first: a telemetry-off process makes
+        # zero quality-plane calls (spy-pinned).
+        tele = _telemetry_active()
+        if tele is not None and dataset is not None \
+                and ds is not self.train_data \
+                and bool(getattr(self.config, "quality_monitor", True)):
+            # quality_monitor=false is a full off-switch for THIS booster:
+            # it must neither create a monitor nor feed one another
+            # component created (same guard shape as the scheduler's)
+            from ..obs import quality as _quality
+            mon = _quality.monitor(
+                tele, create=True,
+                top_k=int(getattr(self.config, "quality_top_k", 20)))
+            mon.observe(tele, getattr(self, "quality_name", "model"),
+                        self, layout, 1, ds.binned, "binned",
+                        scores=out[0] if K == 1 else None,
+                        raw_score=True)
         return out
 
     def predict_binned(self, dataset: Optional[BinnedDataset] = None,

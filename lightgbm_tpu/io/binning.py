@@ -250,6 +250,11 @@ class BinMapper:
         self.default_bin: int = 0
         self.most_freq_bin: int = 0
         self.sparse_rate: float = 1.0
+        # per-bin occupancy of the bin-finding sample (int64 [num_bin]) —
+        # the training-time drift baseline obs/quality.py scores served
+        # traffic against; None for mappers loaded from files that predate
+        # its serialization
+        self.cnt_in_bin: Optional[np.ndarray] = None
 
     def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
                  min_data_in_bin: int = 3, min_split_data: int = 0,
@@ -331,6 +336,10 @@ class BinMapper:
                 self.most_freq_bin = self.default_bin
         else:
             self.sparse_rate = 1.0
+        # keep the sample occupancy (previously computed then discarded):
+        # it is the per-feature population-stability baseline — without it
+        # a loaded dataset/model cannot score drift (obs/quality.py)
+        self.cnt_in_bin = np.asarray(cnt_in_bin, dtype=np.int64)
 
     @staticmethod
     def _find_bounds(distinct_values, counts, max_bin, total_sample_cnt,
@@ -464,6 +473,8 @@ class BinMapper:
             "bin_upper_bound": [float(b) for b in self.bin_upper_bound]
                                if self.bin_type == BinType.NUMERICAL else [],
             "bin_2_categorical": list(self.bin_2_categorical),
+            "cnt_in_bin": ([int(c) for c in self.cnt_in_bin]
+                           if self.cnt_in_bin is not None else None),
         }
 
     @classmethod
@@ -481,4 +492,9 @@ class BinMapper:
         m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
         m.bin_2_categorical = [int(c) for c in d["bin_2_categorical"]]
         m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        # absent in files written before the drift baseline existed: the
+        # mapper still bins, it just cannot anchor a PSI comparison
+        cnt = d.get("cnt_in_bin")
+        m.cnt_in_bin = (np.asarray(cnt, dtype=np.int64)
+                        if cnt is not None else None)
         return m

@@ -40,7 +40,7 @@ from .trace import annotate
 __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "EVENT_SCHEMA_VERSION", "read_events", "iter_events",
            "validate_event", "shard_path", "configure", "active", "disable",
-           "annotate", "recompile", "spans"]
+           "annotate", "recompile", "spans", "quality"]
 
 _lock = threading.Lock()
 _active: Optional[Telemetry] = None
@@ -123,3 +123,4 @@ def disable() -> None:
 # every telemetry-off `import lightgbm_tpu`, and all its call sites
 # (configure, serving.Server, Telemetry.close) reach it lazily
 from . import spans  # noqa: E402,F401
+from . import quality  # noqa: E402,F401

@@ -89,7 +89,8 @@ def _esc_label(v) -> str:
 
 
 def render_prometheus(snapshot: Dict[str, Any],
-                      run_recompiles: Optional[int] = None) -> str:
+                      run_recompiles: Optional[int] = None,
+                      quality: Optional[Dict[str, Any]] = None) -> str:
     """Registry snapshot -> Prometheus text exposition (0.0.4).
 
     Counters render as ``counter``, gauges as ``gauge``, histograms as
@@ -97,7 +98,10 @@ def render_prometheus(snapshot: Dict[str, Any],
     always-on process counters ride along with labels; ``run_recompiles``
     (jit cache misses SINCE the active run's baseline) is the live form of
     the steady-state no-recompile invariant — 0 on a healthy serving
-    process."""
+    process.  ``quality`` is a ``QualityMonitor.snapshot()``: per-model
+    drift PSI per feature (already top-K bounded by the monitor, so a
+    wide-F model cannot blow up the exposition), score PSI, generation and
+    freshness — the model-quality plane's labeled gauges."""
     from .. import resilience
     from ..utils.file_io import io_retry_count
     from . import launches, recompile
@@ -153,6 +157,42 @@ def render_prometheus(snapshot: Dict[str, Any],
            or ["%s 0" % fb])
     io = _PREFIX + "io_retries_total"
     metric(io, "counter", ["%s %d" % (io, io_retry_count())])
+    # model-quality plane (obs/quality.py): labeled per-model gauges,
+    # rendered only when the run monitors traffic (no stale exposition)
+    models = (quality or {}).get("models") or {}
+    if models:
+        def lbl(name):
+            return _esc_label(name)
+
+        dp = _PREFIX + "drift_psi"
+        samples = []
+        for m, info in sorted(models.items()):
+            for f in info.get("features") or []:
+                samples.append('%s{model="%s",feature="%s"} %s'
+                               % (dp, lbl(m), lbl(f.get("name")),
+                                  _prom_val(f.get("psi"))))
+        if samples:
+            metric(dp, "gauge", samples)
+        sp = _PREFIX + "score_psi"
+        metric(sp, "gauge",
+               ['%s{model="%s"} %s' % (sp, lbl(m),
+                                       _prom_val(info.get("score_psi")))
+                for m, info in sorted(models.items())])
+        gen = _PREFIX + "model_generation"
+        metric(gen, "gauge",
+               ['%s{model="%s"} %s' % (gen, lbl(m),
+                                       _prom_val(info.get("generation")))
+                for m, info in sorted(models.items())])
+        beh = _PREFIX + "model_seconds_behind"
+        metric(beh, "gauge",
+               ['%s{model="%s"} %s'
+                % (beh, lbl(m), _prom_val(info.get("seconds_behind")))
+                for m, info in sorted(models.items())])
+        qr = _PREFIX + "quality_rows_observed"
+        metric(qr, "gauge",
+               ['%s{model="%s"} %s' % (qr, lbl(m),
+                                       _prom_val(info.get("rows")))
+                for m, info in sorted(models.items())])
     return "\n".join(lines) + "\n"
 
 
@@ -272,7 +312,10 @@ class MetricsExporter:
         base = getattr(self.tele, "recompile_baseline", {})
         run = sum(max(n - base.get(k, 0), 0)
                   for k, n in recompile.counts().items())
-        return render_prometheus(snap, run_recompiles=run)
+        mon = getattr(self.tele, "quality", None)
+        return render_prometheus(snap, run_recompiles=run,
+                                 quality=mon.snapshot()
+                                 if mon is not None else None)
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -281,6 +281,10 @@ class Telemetry:
         # the live scrape listener (obs/exporter.py) owned by this run;
         # close() shuts it down with the run
         self.exporter = None
+        # the model-quality monitor (obs/quality.py) owned by this run;
+        # created lazily by quality.monitor(tele, create=True) — None on
+        # runs that never serve/score traffic
+        self.quality = None
         self.freq = max(int(freq), 1)
         # newest-EVENT_BUFFER_CAP mirror of the JSONL stream (the file is
         # the durable record); event_count is the total ever recorded

@@ -82,6 +82,9 @@ def serving_block(counters: Dict[str, Any], gauges: Dict[str, Any],
         info["qps"] = (req / wall) if (req and wall) else None
     return {
         "models": models,
+        # the never-drop invariant (Server.close records it; None on runs
+        # that died before close — the counters above still reconstruct)
+        "dropped": gauges.get("serve_dropped"),
         "batches": int(counters.get("serve_batches", 0)),
         "single_row_fast": int(counters.get("serve_single_row_fast", 0)),
         "rejected": int(counters.get("serve_rejected", 0)),
@@ -187,6 +190,14 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
     serving = serving_block(counters, gauges, hists)
     if serving is not None:
         out["serving"] = serving
+    # model-quality rollup (obs/quality.py): per-model drift PSI/JS ranked
+    # by importance, score PSI, generation + freshness — present only when
+    # the run monitored traffic
+    mon = getattr(tele, "quality", None)
+    if mon is not None:
+        q = mon.snapshot()
+        if q:
+            out["quality"] = q
     if extra:
         out.update(extra)
     return out
@@ -247,6 +258,27 @@ def human_table(summary: Dict[str, Any]) -> str:
         if srv.get("rejected") or srv.get("failed"):
             row("    rejected/failed", "%d/%d"
                 % (srv.get("rejected", 0), srv.get("failed", 0)))
+    qual = summary.get("quality") or {}
+    if qual.get("models"):
+        lines.append("  quality:")
+        for name, info in sorted(qual["models"].items()):
+            row("    model %s" % name,
+                "gen=%s rows=%d level=%s psi_max=%s@%s score_psi=%s "
+                "behind=%ss"
+                % (info.get("generation"), info.get("rows", 0),
+                   info.get("level", "ok"),
+                   "-" if info.get("psi_max") is None
+                   else "%.4f" % info["psi_max"],
+                   info.get("feature_max") or "-",
+                   "-" if info.get("score_psi") is None
+                   else "%.4f" % info["score_psi"],
+                   "-" if info.get("seconds_behind") is None
+                   else "%.0f" % info["seconds_behind"]))
+            for f in (info.get("features") or [])[:5]:
+                row("      %s" % f.get("name"),
+                    "psi=%.4f js=%.4f imp=%.4f"
+                    % (f.get("psi", 0.0), f.get("js", 0.0),
+                       f.get("importance", 0.0)))
     res = summary.get("resilience") or {}
     shown = {k: v for k, v in sorted(res.items())
              if (isinstance(v, (int, float)) and v)
@@ -274,6 +306,28 @@ def human_table(summary: Dict[str, Any]) -> str:
     for name, v in sorted(counters.items()):
         row("counter " + name, "%d" % v)
     return "\n".join(lines)
+
+
+def _feature_importance_block(gbdt, top_n: int = 50):
+    """{"split": {name: n}, "gain": {name: x}} for the trained model's
+    nonzero-importance features (top ``top_n`` by gain); None for models
+    with no trees or no importance surface."""
+    try:
+        split = gbdt.feature_importance("split")
+        gain = gbdt.feature_importance("gain")
+    except Exception:
+        return None
+    names = list(getattr(gbdt, "feature_names", []) or [])
+
+    def nm(i):
+        return names[i] if i < len(names) else "Column_%d" % i
+
+    order = sorted(range(len(gain)), key=lambda i: (-gain[i], i))
+    order = [i for i in order if split[i] > 0 or gain[i] > 0][:top_n]
+    if not order:
+        return None
+    return {"split": {nm(i): int(split[i]) for i in order},
+            "gain": {nm(i): round(float(gain[i]), 6) for i in order}}
 
 
 def finalize_run(tele: Telemetry, gbdt=None, wall_s: Optional[float] = None,
@@ -305,6 +359,14 @@ def finalize_run(tele: Telemetry, gbdt=None, wall_s: Optional[float] = None,
             record_training_estimate(
                 tele, gbdt, eff_wall,
                 iters=int(eff_iters) if eff_iters else None)
+        # split/gain feature importance rides the summary: the quality
+        # table ranks drifted features by importance x PSI, and the
+        # artifact should carry the ranking weights it used (top 50 by
+        # gain to bound artifact size)
+        fi = _feature_importance_block(gbdt)
+        if fi is not None:
+            extra = dict(extra or {})
+            extra.setdefault("feature_importance", fi)
     summary = summarize(tele, extra=extra)
     tele.event("run_end", wall_s=wall_s, iterations=iters)
     path = summary_path

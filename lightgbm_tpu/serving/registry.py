@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -102,6 +103,14 @@ class ResidentModel:
         self.inflight = 0
         self.retired = False
         self.evict_pending = False
+        # model-generation provenance (obs/quality.py): stamped from the
+        # registry's per-name counter under the admit lock, so the
+        # generation flips atomically with the name — a request in flight
+        # across a swap attributes its drift to the generation that served
+        # it.  published_at feeds the freshness gauge when the booster
+        # carries no trained-at metadata (loaded models).
+        self.generation = 1
+        self.published_at = time.time()
         # stack the primary (full-range raw) predictors eagerly: they ARE
         # the admission-time footprint estimate.  resident_bytes is the
         # TRUE footprint; accounted_bytes is what the registry has counted
@@ -200,6 +209,13 @@ class ResidentModel:
             self.predict(np.zeros((int(b), n_feat), dtype=np.float32),
                          raw_score=True)
 
+    def quality_baseline(self):
+        """Drift baseline of this resident generation (delegates to the
+        booster's cached builder against the serving layout); None when
+        the model carries no layout dataset."""
+        fn = getattr(self.gbdt, "quality_baseline", None)
+        return fn(self.layout_ds) if fn is not None else None
+
     def drop(self) -> int:
         """Release the device arrays; returns the bytes the registry had
         ACCOUNTED for this entry (what its ledger must give back)."""
@@ -241,6 +257,10 @@ class ModelRegistry:
         # registry's degradations (the process-global resilience ledger is
         # site-keyed and two registries may hold the same model name)
         self._fallbacks: Dict[str, int] = {}
+        # model-generation counters (quality-plane provenance): survive
+        # eviction/park/re-admission so a readmitted model keeps its
+        # generation; swap() bumps under the SAME lock as the name flip
+        self._generations: Dict[str, int] = {}
 
     def _note_fallback(self, site: str) -> None:
         with self._lock:
@@ -280,8 +300,12 @@ class ModelRegistry:
             tele.event("serve_evict", model=_safe_name(name))
 
     def _admit_locked(self, entry: ResidentModel) -> None:
-        """Under the lock: evict to fit, publish, account."""
+        """Under the lock: evict to fit, publish, account.  The generation
+        stamp happens HERE — the same lock acquisition that flips the name
+        — so baseline+generation switch atomically with the publish and a
+        hot-swap never scores new traffic against the old baseline."""
         self._evict_for(entry.resident_bytes, keep=entry.name)
+        entry.generation = self._generations.setdefault(entry.name, 1)
         self._resident[entry.name] = entry
         self._resident.move_to_end(entry.name)
         self._bytes += entry.resident_bytes
@@ -290,6 +314,12 @@ class ModelRegistry:
         if tele is not None:
             tele.gauge("serve_resident_models").set(len(self._resident))
             tele.gauge("serve_resident_bytes").set(self._bytes)
+            mon = getattr(tele, "quality", None)
+            if mon is not None:
+                mon.note_generation(
+                    _safe_name(entry.name), entry.generation,
+                    trained_at=getattr(entry.gbdt, "trained_at", None),
+                    published_at=entry.published_at)
 
     def _note_growth(self, entry: ResidentModel, grew: int) -> None:
         """A resident built a new predictor range: account it and rebalance
@@ -318,6 +348,11 @@ class ModelRegistry:
                 raise LightGBMError(
                     "model %r is already registered; use swap() to "
                     "republish it" % name)
+            # a fresh register is a NEW generation even when the name was
+            # used before (unregister + register is a legal republish that
+            # skips swap): reusing the retired number would fold the new
+            # model's traffic into the retired generation's drift state
+            self._generations[name] = self._generations.get(name, 0) + 1
             self._building[name] = (_unwrap(booster), layout_ds)
         try:
             entry = ResidentModel(name, booster, layout_ds=layout_ds,
@@ -385,12 +420,17 @@ class ModelRegistry:
                 old.retired = True
                 if old.inflight == 0:
                     self._bytes -= old.drop()
+            # bump the generation UNDER the flip lock: in-flight requests
+            # keep the old entry's stamp (their drift attributes to the
+            # generation that served them), arrivals get the new one
+            self._generations[name] = self._generations.get(name, 1) + 1
             self._admit_locked(entry)
             self.swaps += 1
             tele = _telemetry_active()
             if tele is not None:
                 tele.counter("serve_swaps").inc()
                 tele.event("serve_swap", model=_safe_name(name),
+                           generation=int(entry.generation),
                            deferred=bool(old is not None
                                          and old.inflight > 0))
         return entry

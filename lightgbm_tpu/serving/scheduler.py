@@ -98,7 +98,8 @@ class Server:
                  max_queue_depth: int = 0,
                  owned_telemetry=None,
                  metrics_port: Optional[int] = None,
-                 metrics_addr: Optional[str] = None) -> None:
+                 metrics_addr: Optional[str] = None,
+                 quality_monitor: Optional[bool] = None) -> None:
         # a telemetry run THIS server owns (engine.serve opened it for us):
         # close() finalizes it into <telemetry_out>.summary.json and
         # releases the process-active slot, same ownership rule as
@@ -114,6 +115,24 @@ class Server:
             single_row_fast if single_row_fast is not None
             else _cfg("serve_single_row_fast", False))
         self.max_queue_depth = int(max_queue_depth)
+        # quality plane (obs/quality.py): drift/score monitoring over the
+        # served traffic, sampled by telemetry_freq; host-only work that
+        # runs AFTER every future in a batch has resolved
+        self.quality_enabled = bool(
+            quality_monitor if quality_monitor is not None
+            else _cfg("quality_monitor", True))
+        self.quality_top_k = int(_cfg("quality_top_k", 20))
+        if self.quality_enabled:
+            # eager when a run is already live: register()'s admit stamps
+            # generation/freshness provenance into the monitor, so the
+            # gauges render BEFORE the model sees monitored traffic.  (A
+            # run configured later still gets a monitor lazily at the
+            # first sampled observe.)
+            tele = _telemetry_active()
+            if tele is not None:
+                from ..obs import quality as _quality
+                _quality.monitor(tele, create=True,
+                                 top_k=self.quality_top_k)
         self.registry = registry if registry is not None else ModelRegistry(
             budget_mb=float(residency_budget_mb
                             if residency_budget_mb is not None
@@ -465,6 +484,22 @@ class Server:
             # (spans only need relative alignment)
             if tele.freq > 1 and self.batches % tele.freq:
                 return
+            # quality plane: fold the batch's REAL rows (no padding) and
+            # scores into the drift counters — same telemetry_freq
+            # sampling as the spans, host numpy only, after every future
+            # in the batch resolved (never on the dispatch critical path).
+            # Generation attribution rides the entry acquired for THIS
+            # dispatch, so a request in flight across a swap scores
+            # against the generation that actually served it.
+            if self.quality_enabled:
+                from ..obs import quality as _quality
+                mon = _quality.monitor(tele, create=True,
+                                       top_k=self.quality_top_k)
+                mon.observe(tele, m, entry.gbdt, entry.layout_ds,
+                            entry.generation, rows, key.kind,
+                            scores=(np.asarray(out) if entry.K == 1
+                                    else None),
+                            raw_score=key.raw_score)
             wall, pc = time.time(), time.perf_counter()
 
             def w(t: float) -> float:
@@ -563,6 +598,14 @@ class Server:
         _exporter.unregister_health_provider(self._health_key,
                                              self._health_info)
         tele = _telemetry_active()
+        if tele is not None:
+            # the never-drop invariant as a gauge: perf_gate checks it on
+            # the summary artifact (0 on every healthy run, by arithmetic
+            # identical to stats()["dropped"])
+            with self._cond:
+                dropped = (self.submitted - self.completed - self.failed
+                           - self.cancelled - self._inflight - self._queued)
+            tele.gauge("serve_dropped").set(dropped)
         if tele is not None and self._t_first is not None:
             end = self._t_last if self._t_last is not None \
                 else time.perf_counter()

@@ -1,0 +1,580 @@
+"""Model-quality plane (lightgbm_tpu/obs/quality.py): PSI/JS goldens,
+drift baselines on BinMapper (persisted through the binary round-trip),
+covariate-shift detection that flags exactly the shifted features,
+serving-tier generation provenance flipping atomically with swap, summary/
+exposition/died-run surfacing, and the zero-overhead + zero-recompile
+invariants the rest of the obs stack already pins.
+"""
+import json
+import math
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.io.binning import BinMapper, BinType, MissingType
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.obs.quality import (DRIFT_GROUPS, PSI_ALERT, PSI_WARN,
+                                      QualityBaseline, QualityMonitor,
+                                      ScoreFingerprint, drift_level,
+                                      js_divergence, mass_groups, psi)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _toy_booster(n=800, num_iterations=8, seed=0, shift_col=None,
+                 max_bin=31, **params):
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2, 2, size=(n, 6)).astype(np.float32)
+    if shift_col is not None:
+        X[:, shift_col] = rng.uniform(5, 9, n).astype(np.float32)
+    y = X[:, 1] * 2 + 0.1 * rng.normal(size=n)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=max_bin,
+                                   min_data_in_leaf=5)
+    cfg = Config(objective="regression", num_leaves=8, min_data_in_leaf=5,
+                 num_iterations=num_iterations, verbosity=-1, **params)
+    b = GBDT(cfg, ds, create_objective("regression", cfg))
+    for _ in range(num_iterations):
+        b.train_one_iter()
+    return b, X, ds
+
+
+# ---- PSI / JS goldens (hand-computed) ----
+
+def test_psi_golden_values():
+    assert psi([50, 50], [50, 50]) == 0.0
+    # p=(0.9,0.1) vs a=(0.1,0.9): 2 * 0.8*ln(9) = 3.515559...
+    assert psi([90, 10], [10, 90]) == pytest.approx(1.6 * math.log(9.0),
+                                                    rel=1e-12)
+    # scale invariance: proportions, not counts
+    assert psi([9, 1], [100, 900]) == pytest.approx(1.6 * math.log(9.0),
+                                                    rel=1e-12)
+
+
+def test_psi_empty_bin_is_large_and_finite():
+    # expected=(1.0, eps-floored 0), actual=(0.5, 0.5):
+    # (0.5-1)ln(0.5) + (0.5-1e-6)ln(0.5/1e-6)
+    eps = 1e-6
+    want = (0.5 - 1.0) * math.log(0.5) \
+        + (0.5 - eps) * math.log(0.5 / eps)
+    got = psi([100, 0], [50, 50])
+    assert got == pytest.approx(want, rel=1e-9)
+    assert math.isfinite(got) and got > PSI_ALERT
+
+
+def test_psi_mismatched_bins_raises():
+    with pytest.raises(ValueError):
+        psi([1, 2, 3], [1, 2])
+    with pytest.raises(ValueError):
+        js_divergence([1, 2, 3], [1, 2])
+
+
+def test_js_golden_values():
+    assert js_divergence([3, 7], [3, 7]) == 0.0
+    # disjoint distributions: exactly 1 bit
+    assert js_divergence([1, 0], [0, 1]) == pytest.approx(1.0, rel=1e-12)
+    # symmetric, bounded
+    a, b = [80, 20], [20, 80]
+    assert js_divergence(a, b) == pytest.approx(js_divergence(b, a))
+    assert 0.0 < js_divergence(a, b) < 1.0
+    # zero bins are exact (0 * log 0 = 0), no eps distortion:
+    # p=(1,0), q=(.5,.5), m=(.75,.25):
+    want = 0.5 * math.log2(1 / 0.75) \
+        + 0.5 * (0.5 * math.log2(0.5 / 0.75) + 0.5 * math.log2(0.5 / 0.25))
+    assert js_divergence([10, 0], [5, 5]) == pytest.approx(want, rel=1e-12)
+
+
+def test_drift_level_thresholds():
+    assert drift_level(None) == "ok"
+    assert drift_level(PSI_WARN - 1e-6) == "ok"
+    assert drift_level(PSI_WARN + 1e-6) == "warn"
+    assert drift_level(PSI_ALERT + 1e-6) == "alert"
+
+
+def test_mass_groups_equal_mass_and_nan_pin():
+    counts = np.full(64, 10, dtype=np.int64)
+    groups, ng = mass_groups(counts)
+    assert ng <= DRIFT_GROUPS and groups[0] == 0 and groups[-1] == ng - 1
+    agg = np.bincount(groups, weights=counts, minlength=ng)
+    # roughly equal mass per group
+    assert agg.min() >= 0.5 * agg.max()
+    # NaN bin pinned to its own group regardless of its (zero) mass
+    counts[-1] = 0
+    groups, ng = mass_groups(counts, own_last_bin=True)
+    assert groups[-1] == ng - 1
+    assert np.sum(groups == ng - 1) == 1
+    # few bins: identity mapping
+    groups, ng = mass_groups([5, 5, 5])
+    assert list(groups) == [0, 1, 2] and ng == 3
+
+
+# ---- cnt_in_bin baseline on BinMapper ----
+
+def test_cnt_in_bin_numerical_with_nan_bin():
+    rng = np.random.RandomState(0)
+    vals = np.concatenate([rng.uniform(-1, 1, 500), [np.nan] * 40])
+    m = BinMapper()
+    m.find_bin(vals, len(vals), 16, min_data_in_bin=3)
+    assert m.missing_type == MissingType.NAN
+    assert m.cnt_in_bin is not None
+    assert m.cnt_in_bin.sum() == len(vals)
+    assert m.cnt_in_bin[-1] == 40  # the NaN bin
+    # occupancy matches re-binning the sample
+    rebinned = np.bincount(m.values_to_bins(vals), minlength=m.num_bin)
+    assert np.array_equal(m.cnt_in_bin, rebinned)
+
+
+def test_cnt_in_bin_categorical_and_unseen_bin():
+    rng = np.random.RandomState(1)
+    vals = rng.choice([1, 2, 3, 7], size=400, p=[0.5, 0.3, 0.15, 0.05])
+    m = BinMapper()
+    m.find_bin(vals.astype(np.float64), len(vals), 16,
+               bin_type=BinType.CATEGORICAL)
+    assert m.cnt_in_bin is not None
+    assert m.cnt_in_bin.sum() == len(vals)
+    # count-sorted: bin 0 holds the most frequent category
+    assert m.cnt_in_bin[0] == m.cnt_in_bin.max()
+    # unseen categories route to the LAST bin — drift counters see them
+    unseen = m.values_to_bins(np.asarray([99.0, 5.0]))
+    assert list(unseen) == [m.num_bin - 1] * 2
+
+
+def test_cnt_in_bin_serializes_and_tolerates_legacy():
+    rng = np.random.RandomState(2)
+    m = BinMapper()
+    m.find_bin(rng.uniform(0, 1, 300), 300, 8)
+    d = m.to_dict()
+    assert d["cnt_in_bin"] is not None
+    m2 = BinMapper.from_dict(d)
+    assert np.array_equal(m2.cnt_in_bin, m.cnt_in_bin)
+    # files written before the baseline existed load with cnt None
+    legacy = {k: v for k, v in d.items() if k != "cnt_in_bin"}
+    m3 = BinMapper.from_dict(legacy)
+    assert m3.cnt_in_bin is None
+    assert m3.num_bin == m.num_bin
+
+
+def test_dataset_binary_roundtrip_carries_baseline(tmp_path):
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(400, 4))
+    ds = BinnedDataset.from_matrix(X, label=np.zeros(400), max_bin=16)
+    path = str(tmp_path / "d.bin")
+    ds.save_binary(path)
+    ds2 = BinnedDataset.load_binary(path)
+    for m1, m2 in zip(ds.bin_mappers, ds2.bin_mappers):
+        if m1.cnt_in_bin is None:
+            assert m2.cnt_in_bin is None
+        else:
+            assert np.array_equal(m1.cnt_in_bin, m2.cnt_in_bin)
+
+
+# ---- score fingerprint ----
+
+def test_score_fingerprint_roundtrip_and_shift():
+    rng = np.random.RandomState(4)
+    s = rng.normal(size=4000)
+    fp = ScoreFingerprint.from_scores(s)
+    assert fp is not None and len(fp.counts) == len(fp.edges) + 1
+    assert fp.psi_of(rng.normal(size=4000)) < PSI_WARN
+    assert fp.psi_of(rng.normal(size=4000) + 2.0) > PSI_ALERT
+    fp2 = ScoreFingerprint.from_dict(fp.to_dict())
+    assert np.array_equal(fp2.edges, fp.edges)
+    assert np.array_equal(fp2.counts, fp.counts)
+    assert ScoreFingerprint.from_scores([]) is None
+    assert fp.psi_of([]) is None
+
+
+# ---- baseline from a trained model ----
+
+def test_quality_baseline_from_model():
+    b, X, ds = _toy_booster()
+    base = b.quality_baseline()
+    assert base is not None and base.monitorable()
+    assert len(base.features) == ds.num_features
+    # importance normalized; the label-driving feature dominates
+    imps = {f.name: f.importance for f in base.features}
+    assert imps["Column_1"] == max(imps.values()) > 0
+    assert sum(imps.values()) == pytest.approx(1.0, abs=1e-6)
+    assert b.trained_at is not None
+    assert base.trained_at == b.trained_at
+    # score fingerprints captured from the training score cache
+    assert base.score_raw is not None
+    # cached per model generation
+    assert b.quality_baseline() is base
+    # no layout dataset -> no baseline (not an error)
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    loaded = GBDT(Config(objective="regression", verbosity=-1))
+    loaded.load_model_from_string(b.save_model_to_string())
+    assert loaded.quality_baseline() is None
+
+
+# ---- covariate shift detection ----
+
+def _observe(mon, tele, b, ds, rows, kind, gen=1, scores=None):
+    mon.observe(tele, "m", b, ds, gen, rows, kind, scores=scores,
+                raw_score=True)
+
+
+def test_covariate_shift_flags_exactly_shifted_features():
+    b, X, ds = _toy_booster(n=1200)
+    tele = obs.configure(freq=1)
+    mon = QualityMonitor()
+    rng = np.random.RandomState(5)
+    served = X[rng.randint(0, len(X), 2000)].copy()
+    served[:, 3] = rng.uniform(5, 9, len(served))  # inject the shift
+    _observe(mon, tele, b, ds, served, "raw")
+    info = mon.snapshot()["models"]["m"]
+    by_name = {f["name"]: f for f in info["features"]}
+    assert by_name["Column_3"]["psi"] > PSI_ALERT
+    for name, f in by_name.items():
+        if name != "Column_3":
+            assert f["psi"] < PSI_WARN, f
+    assert info["psi_max"] == by_name["Column_3"]["psi"]
+    assert info["feature_max"] == "Column_3"
+    assert info["level"] == "alert"
+
+
+def test_binned_and_raw_routes_fold_identically():
+    b, X, ds = _toy_booster(n=1000)
+    tele = obs.configure(freq=1)
+    rng = np.random.RandomState(6)
+    idx = rng.randint(0, len(X), 1500)
+    mon_raw, mon_bin = QualityMonitor(), QualityMonitor()
+    _observe(mon_raw, tele, b, ds, X[idx], "raw")
+    _observe(mon_bin, tele, b, ds, ds.binned[idx], "binned")
+    st_raw = mon_raw._states["m"][1]
+    st_bin = mon_bin._states["m"][1]
+    for a, c in zip(st_raw.counts, st_bin.counts):
+        assert np.array_equal(a, c)
+
+
+def test_nan_surge_lands_in_nan_bin_psi():
+    rng = np.random.RandomState(7)
+    n = 1000
+    X = rng.uniform(-2, 2, size=(n, 2))
+    X[:50, 0] = np.nan  # training sees 5% missing
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objective import create_objective
+    ds = BinnedDataset.from_matrix(X, label=X[:, 1], max_bin=16,
+                                   min_data_in_leaf=5)
+    cfg = Config(objective="regression", num_leaves=8, min_data_in_leaf=5,
+                 verbosity=-1)
+    b = GBDT(cfg, ds, create_objective("regression", cfg))
+    b.train_one_iter()
+    tele = obs.configure(freq=1)
+    mon = QualityMonitor()
+    served = X[rng.randint(0, n, 1500)].copy()
+    served[:, 0] = np.nan  # 100% missing in traffic
+    _observe(mon, tele, b, ds, served, "raw")
+    info = mon.snapshot()["models"]["m"]
+    by_name = {f["name"]: f for f in info["features"]}
+    assert by_name["Column_0"]["psi"] > PSI_ALERT
+    assert by_name["Column_1"]["psi"] < PSI_WARN
+
+
+def test_categorical_unseen_category_drift():
+    rng = np.random.RandomState(8)
+    n = 1200
+    X = np.stack([rng.choice([1.0, 2.0, 3.0], size=n, p=[0.6, 0.3, 0.1]),
+                  rng.uniform(-1, 1, n)], axis=1)
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objective import create_objective
+    ds = BinnedDataset.from_matrix(X, label=X[:, 1], max_bin=16,
+                                   min_data_in_leaf=5,
+                                   categorical_feature=[0])
+    cfg = Config(objective="regression", num_leaves=8, min_data_in_leaf=5,
+                 verbosity=-1)
+    b = GBDT(cfg, ds, create_objective("regression", cfg))
+    b.train_one_iter()
+    tele = obs.configure(freq=1)
+    mon = QualityMonitor()
+    served = X[rng.randint(0, n, 1500)].copy()
+    served[:, 0] = 77.0  # a category training never saw
+    _observe(mon, tele, b, ds, served, "raw")
+    info = mon.snapshot()["models"]["m"]
+    by_name = {f["name"]: f for f in info["features"]}
+    assert by_name["Column_0"]["psi"] > PSI_ALERT
+    assert by_name["Column_1"]["psi"] < PSI_WARN
+
+
+# ---- serving integration: generation provenance + atomic swap ----
+
+def test_serving_monitor_and_swap_flips_generation_and_baseline():
+    from lightgbm_tpu.obs import recompile
+    from lightgbm_tpu.serving import Server
+    b_old, X, _ = _toy_booster(seed=0)
+    b_new, _, _ = _toy_booster(seed=2, shift_col=0)
+    tele = obs.configure(freq=1)
+    srv = Server(max_batch_wait_us=0)
+    try:
+        srv.register("m", b_old)
+        rng = np.random.RandomState(9)
+
+        def rows():
+            return X[rng.randint(0, len(X), 256)]
+
+        # warm both buckets, then pin: monitor-on serving must not compile
+        srv.predict("m", X[:1])
+        srv.predict("m", rows())
+        base_rc = recompile.total()
+        for _ in range(8):
+            srv.predict("m", rows())
+        srv.swap("m", b_new, warm=(128, 1024))
+        for _ in range(8):
+            srv.predict("m", rows())
+        assert recompile.total() - base_rc == 0
+        stats = srv.stats()
+        assert stats["dropped"] == 0 and stats["failed"] == 0
+    finally:
+        srv.close()
+    mon = tele.quality
+    assert mon is not None
+    snap = mon.snapshot()
+    gens = snap["generations"]["m"]
+    assert set(gens) == {"1", "2"}
+    # generation 1 served matched traffic: quiet everywhere
+    assert all(f["psi"] < PSI_WARN for f in gens["1"]["features"])
+    # generation 2's baseline is the NEW model's: the un-shifted traffic
+    # alerts on exactly the swapped feature — the swap flipped the drift
+    # baseline together with the name
+    by_name = {f["name"]: f for f in gens["2"]["features"]}
+    assert by_name["Column_0"]["psi"] > PSI_ALERT
+    assert all(f["psi"] < PSI_WARN for n, f in by_name.items()
+               if n != "Column_0")
+    assert snap["models"]["m"]["generation"] == 2
+    # dropped gauge recorded for the perf gate
+    assert tele.gauge("serve_dropped").value == 0
+    # summary carries the quality block
+    from lightgbm_tpu.obs.report import summarize
+    s = summarize(tele)
+    assert s["quality"]["models"]["m"]["generation"] == 2
+    assert s["serving"]["dropped"] == 0
+
+
+def test_generation_survives_park_and_readmit():
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    b1, _, _ = _toy_booster(seed=0, num_iterations=2)
+    b2, _, _ = _toy_booster(seed=1, num_iterations=2)
+    reg = ModelRegistry(budget_mb=0)
+    reg.register("a", b1)
+    reg.swap("a", b2)
+    entry = reg.acquire("a")
+    try:
+        assert entry.generation == 2
+    finally:
+        reg.release(entry)
+
+
+def test_register_after_unregister_is_a_new_generation():
+    """unregister + register is a legal republish that skips swap(): the
+    name must NOT resurrect the retired generation number, or the quality
+    monitor would fold the new model's traffic into the retired model's
+    state and score it against the retired baseline."""
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    b1, _, _ = _toy_booster(seed=0, num_iterations=2)
+    b2, _, _ = _toy_booster(seed=1, num_iterations=2)
+    reg = ModelRegistry(budget_mb=0)
+    e1 = reg.register("a", b1)
+    assert e1.generation == 1
+    reg.unregister("a")
+    e2 = reg.register("a", b2)
+    assert e2.generation == 2
+
+
+# ---- surfacing: exposition, summary, died-run recovery ----
+
+def test_prometheus_exposition_labels_and_top_k():
+    b, X, ds = _toy_booster()
+    tele = obs.configure(freq=1)
+    mon = QualityMonitor(top_k=3)
+    rng = np.random.RandomState(10)
+    _observe(mon, tele, b, ds, X[rng.randint(0, len(X), 1000)], "raw",
+             scores=rng.normal(size=1000))
+    mon.note_generation("m", 1, trained_at=b.trained_at)
+    snap = mon.snapshot()
+    assert len(snap["models"]["m"]["features"]) <= 3  # top-K bound
+    from lightgbm_tpu.obs.exporter import render_prometheus
+    text = render_prometheus(tele.registry.snapshot(), quality=snap)
+    assert 'lgbm_tpu_drift_psi{model="m",feature="' in text
+    assert text.count("lgbm_tpu_drift_psi{") <= 3
+    assert 'lgbm_tpu_model_generation{model="m"} 1.0' in text
+    assert 'lgbm_tpu_model_seconds_behind{model="m"}' in text
+    assert 'lgbm_tpu_quality_rows_observed{model="m"}' in text
+    # a run with no monitored traffic exposes NO quality series
+    clean = render_prometheus(tele.registry.snapshot(), quality=None)
+    assert "drift_psi" not in clean
+
+
+def test_live_metrics_endpoint_serves_quality(tmp_path):
+    import urllib.request
+    b, X, ds = _toy_booster()
+    tele = obs.configure(freq=1, metrics_port=0)
+    from lightgbm_tpu.obs.exporter import start_exporter
+    exp = start_exporter(tele, port=0)
+    mon = QualityMonitor()
+    tele.quality = mon
+    _observe(mon, tele, b, ds, X[:500], "raw")
+    url = "http://127.0.0.1:%d/metrics" % exp.port
+    text = urllib.request.urlopen(url, timeout=10).read().decode()
+    assert 'lgbm_tpu_drift_psi{model="m"' in text
+    obs.disable()
+
+
+def test_drift_events_and_died_run_recovery(tmp_path):
+    import sys
+    b, X, ds = _toy_booster()
+    path = str(tmp_path / "q.jsonl")
+    tele = obs.configure(out=path, freq=1)
+    mon = QualityMonitor()
+    rng = np.random.RandomState(11)
+    served = X[rng.randint(0, len(X), 1200)].copy()
+    served[:, 2] = rng.uniform(5, 9, len(served))
+    # power-of-two + every-16th cadence: 17 observations emit at
+    # 1, 2, 4, 8, 16 — the latest breadcrumb is near-fresh even for a
+    # short-lived generation
+    for _ in range(17):
+        _observe(mon, tele, b, ds, served[:70], "raw")
+    tele.flush()
+    events = obs.read_events(path)
+    drift = [e for e in events if e["kind"] == "drift"]
+    assert len(drift) == 5
+    last = drift[-1]
+    assert last["model"] == "m" and last["generation"] == 1
+    assert last["rows"] == 16 * 70  # emitted AT observation 16
+    top = json.loads(last["top"])
+    assert any(f["name"] == "Column_2" and f["psi"] > PSI_ALERT
+               for f in top)
+    # the died-run path: rebuild the quality block from raw events only
+    sys.path.insert(0, "tools")
+    from obs_report import summary_from_events
+    rec = summary_from_events(events)
+    q = rec["quality"]
+    assert q["models"]["m"]["generation"] == 1
+    assert any(f["name"] == "Column_2" for f in q["models"]["m"]["features"])
+    # and the human table renders it
+    from lightgbm_tpu.obs.report import human_table
+    table = human_table(rec)
+    assert "quality:" in table and "model m" in table
+
+
+def test_finalize_run_emits_feature_importance(tmp_path):
+    b, X, ds = _toy_booster()
+    path = str(tmp_path / "t.jsonl")
+    tele = obs.configure(out=path, freq=1)
+    from lightgbm_tpu.obs.report import finalize_run
+    summary = finalize_run(tele, gbdt=b, wall_s=1.0, iters=8)
+    fi = summary["feature_importance"]
+    assert set(fi) == {"split", "gain"}
+    assert fi["gain"]["Column_1"] == max(fi["gain"].values()) > 0
+    assert all(v > 0 for v in fi["split"].values())
+    with open(path + ".summary.json") as fh:
+        on_disk = json.load(fh)
+    assert on_disk["feature_importance"]["split"] == fi["split"]
+
+
+def test_binned_predict_path_observes_external_dataset():
+    b, X, ds = _toy_booster()
+    rng = np.random.RandomState(12)
+    Xs = X[rng.randint(0, len(X), 900)].copy()
+    Xs[:, 4] = rng.uniform(5, 9, len(Xs))
+    ext = BinnedDataset.from_matrix(Xs, label=np.zeros(len(Xs)),
+                                    reference=ds)
+    tele = obs.configure(freq=1)
+    b.predict_binned(ext)
+    mon = tele.quality
+    assert mon is not None  # created on demand by the predict hook
+    info = mon.snapshot()["models"]["model"]
+    by_name = {f["name"]: f for f in info["features"]}
+    assert by_name["Column_4"]["psi"] > PSI_ALERT
+    assert by_name["Column_1"]["psi"] < PSI_WARN
+    # the training-data replay stays OUT of the drift counters
+    rows_before = mon._states["model"][1].rows
+    b.predict_binned()   # dataset=None -> train data
+    assert mon._states["model"][1].rows == rows_before
+
+
+def test_generation_gauge_renders_before_any_traffic():
+    """Registering into a live run stamps provenance immediately: the
+    generation/freshness gauges render on /metrics BEFORE the model sees
+    a single monitored request."""
+    from lightgbm_tpu.obs.exporter import render_prometheus
+    from lightgbm_tpu.serving import Server
+    b, _, _ = _toy_booster()
+    tele = obs.configure(freq=1)
+    srv = Server(max_batch_wait_us=0)
+    try:
+        srv.register("cold", b)
+        snap = tele.quality.snapshot()
+        assert snap["models"]["cold"]["generation"] == 1
+        assert snap["models"]["cold"]["rows"] == 0
+        text = render_prometheus(tele.registry.snapshot(), quality=snap)
+        assert 'lgbm_tpu_model_generation{model="cold"} 1.0' in text
+        assert 'lgbm_tpu_model_seconds_behind{model="cold"}' in text
+    finally:
+        srv.close()
+
+
+def test_merge_recovery_aggregates_rank_shards():
+    """Pod-mode died-run recovery: per-rank cumulative drift breadcrumbs
+    must aggregate (rows summed, dominant shard's PSI view), not have one
+    rank silently overwrite the others."""
+    import sys
+    sys.path.insert(0, "tools")
+    from obs_report import summary_from_events
+
+    def drift_event(rank, rows, psi_max):
+        return {"v": 1, "ts": 1.0, "kind": "drift", "rank": rank,
+                "model": "m", "generation": 1, "rows": rows,
+                "psi_max": psi_max, "feature_max": "Column_0",
+                "score_psi": None, "level": "ok",
+                "top": json.dumps([{"name": "Column_0", "psi": psi_max,
+                                    "js": 0.0, "importance": 1.0,
+                                    "weight": psi_max}])}
+
+    rec = summary_from_events([
+        drift_event(0, 100, 0.01), drift_event(0, 400, 0.02),  # rank 0
+        drift_event(1, 300, 0.05),                             # rank 1
+    ])
+    entry = rec["quality"]["generations"]["m"]["1"]
+    assert entry["rows"] == 700          # latest-per-rank, summed
+    assert entry["ranks"] == 2
+    assert entry["psi_max"] == 0.02      # dominant (most-rows) shard
+
+
+def test_booster_quality_monitor_off_skips_existing_monitor():
+    """quality_monitor=false on a booster is a full off-switch for its
+    binned predict hook even when ANOTHER component already created the
+    run's monitor."""
+    b, X, ds = _toy_booster(quality_monitor=False)
+    tele = obs.configure(freq=1)
+    mon = QualityMonitor()
+    tele.quality = mon  # someone else's monitor is live
+    ext = BinnedDataset.from_matrix(X[:300].copy(), label=np.zeros(300),
+                                    reference=ds)
+    b.predict_binned(ext)
+    assert mon._states == {}
+
+
+def test_monitor_off_param_disables_accumulation():
+    from lightgbm_tpu.serving import Server
+    b, X, _ = _toy_booster()
+    tele = obs.configure(freq=1)
+    srv = Server(max_batch_wait_us=0, quality_monitor=False)
+    try:
+        srv.register("m", b)
+        srv.predict("m", X[:64])
+    finally:
+        srv.close()
+    assert tele.quality is None

@@ -371,10 +371,24 @@ def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch, tmp_path):
     monkeypatch.setattr(
         obs_exporter.MetricsExporter, "__init__",
         lambda self, *a, **k: calls.append(("MetricsExporter", a)))
+    # quality plane (round 15): zero monitor constructions, zero observes,
+    # zero baseline builds with telemetry off — over the serving scheduler,
+    # the binned predict hook and the registry provenance notes alike
+    from lightgbm_tpu.obs import quality as obs_quality
+    monkeypatch.setattr(
+        obs_quality.QualityMonitor, "__init__",
+        lambda self, *a, **k: calls.append(("QualityMonitor", a)))
+    monkeypatch.setattr(
+        obs_quality.QualityMonitor, "observe",
+        lambda self, *a, **k: calls.append(("quality_observe", a)))
+    monkeypatch.setattr(
+        obs_quality.QualityBaseline, "from_model",
+        classmethod(lambda cls, *a, **k: calls.append(("baseline", a))))
     assert obs.active() is None
     booster, X, _ = _toy_booster(num_iterations=8)
     booster.train_chunk(8)
     booster.predict(X[:600])
+    booster.predict_binned()  # the binned quality-hook path, off
     booster.train(None)  # the driver path too
     # a serving round trip (the span-instrumented scheduler) stays silent
     # too, and no listener thread exists anywhere in the process

@@ -7,7 +7,9 @@ bench.py) writes a schema-versioned JSONL event stream plus
 shard per host.  This tool turns those into things people read:
 
 - the end-of-run human table (``obs.report.human_table``) — from the
-  written summary when present, else rebuilt from the events;
+  written summary when present, else rebuilt from the events (serving,
+  resilience AND quality blocks: ``kind="drift"`` breadcrumbs rebuild the
+  per-model, per-generation drift table a died run never summarized);
 - a Chrome-trace/Perfetto JSON (``--trace out.json``): ``kind="span"``
   events (obs/spans.py) become nested lifelines — one lane per trace id,
   so a single serving request shows its queue-wait / coalesce / dispatch
@@ -147,6 +149,10 @@ def summary_from_events(events):
     srv_hists = {}
     # resilience event kind -> summary-counter name (the faults a died run
     # absorbed are exactly what its post-mortem reader wants first)
+    # quality-plane recovery: the monitor emits a kind="drift" breadcrumb
+    # every few observations; the LATEST one per (model, generation)
+    # reconstructs the drift table a died run never wrote to its summary
+    drift = {}
     res_kinds = {"preempt_checkpoint": "preemptions",
                  "io_retry": "io_retries",
                  "predict_fallback": "predict_fallbacks",
@@ -171,6 +177,12 @@ def summary_from_events(events):
             resilience[key] = resilience.get(key, 0) + 1
             if e["kind"] == "watchdog_stall":
                 resilience["watchdog_stall_s"] = e.get("stall_s")
+        if e["kind"] == "drift":
+            # keyed per RANK too: drift breadcrumbs are cumulative
+            # per-process counters, so in --merge pod mode one shard's
+            # latest must not overwrite another's (they aggregate below)
+            drift[(str(e.get("model", "?")), int(e.get("generation", 1)),
+                   e.get("rank"))] = e
         if e["kind"] == "recompile":
             # one event can carry n>1 compiles (a cache that grew by
             # several programs in one dispatch)
@@ -225,8 +237,45 @@ def summary_from_events(events):
     serving = serving_block(
         srv_counters, {},
         {k: h.summary() for k, h in srv_hists.items()})
+    q_models = {}
+    q_gens = {}
+    # fold ranks: rows SUM across shards; the PSI/feature view comes from
+    # the dominant (most-rows) shard — per-rank cumulative counters
+    # cannot be exactly re-merged from breadcrumbs, and the dominant
+    # shard is the honest approximation for a post-mortem
+    by_gen = {}
+    for (m, g, rank), e in sorted(drift.items(),
+                                  key=lambda kv: str(kv[0])):
+        agg = by_gen.setdefault((m, g), {"rows": 0, "ranks": 0,
+                                         "best": None})
+        agg["rows"] += int(e.get("rows", 0))
+        agg["ranks"] += 1
+        if agg["best"] is None \
+                or int(e.get("rows", 0)) > int(agg["best"].get("rows", 0)):
+            agg["best"] = e
+    for (m, g), agg in sorted(by_gen.items()):
+        e = agg["best"]
+        try:
+            feats = json.loads(e.get("top") or "[]")
+        except ValueError:
+            feats = []
+        entry = {"generation": g, "rows": agg["rows"],
+                 "psi_max": e.get("psi_max"),
+                 "feature_max": e.get("feature_max"),
+                 "score_psi": e.get("score_psi"),
+                 "level": e.get("level"),
+                 "features": feats}
+        if agg["ranks"] > 1:
+            entry["ranks"] = agg["ranks"]
+        q_gens.setdefault(m, {})[str(g)] = entry
+        cur = q_models.get(m)
+        if cur is None or g >= cur["generation"]:
+            q_models[m] = entry
+    quality = ({"models": q_models, "generations": q_gens}
+               if q_models else None)
     return {
         **({"serving": serving} if serving else {}),
+        **({"quality": quality} if quality else {}),
         "resilience": resilience,
         "metric": "telemetry_run", "unit": "row-trees/s", "value": None,
         "iterations": None, "wall_s": None,

@@ -21,7 +21,11 @@ budgets:
 - regression factors (``serve_p99_regression``,
   ``ns_per_row_p50_regression``) vs the committed baseline artifacts named
   under ``baselines`` — a new artifact may not be worse than baseline by
-  more than the factor.
+  more than the factor;
+- quality-plane budgets — a monitor-on serving summary keeps
+  ``serving.dropped == 0`` (plus the recompile gauge above) and every
+  model's ``quality.*.overhead_ns_per_row`` under
+  ``quality_overhead_ns_per_row_max``.
 
 Artifact type is sniffed from its keys (telemetry summary / bench-serve
 grid / split-cost / bench.py wrapper), so one invocation can gate a mixed
@@ -174,6 +178,21 @@ def gate_summary(g: Gate, path: str, doc: dict, b: dict,
         g.check(path, "serving rejected", int(srv.get("rejected", 0))
                 <= int(b.get("serving_rejected_max", 0)),
                 "rejected=%s" % srv.get("rejected", 0))
+        if srv.get("dropped") is not None:
+            g.check(path, "serving dropped", int(srv["dropped"])
+                    <= int(b.get("serving_dropped", 0)),
+                    "dropped=%s" % srv["dropped"])
+    # quality-plane budgets: a monitor-on run must keep its host-side
+    # folding cost under the declared ns/row cap (the recompile and
+    # dropped checks above already pin the other monitor-on invariants)
+    qual = doc.get("quality") or {}
+    cap = b.get("quality_overhead_ns_per_row_max")
+    for m, info in sorted((qual.get("models") or {}).items()):
+        ov = info.get("overhead_ns_per_row")
+        if cap is not None and ov is not None:
+            g.check(path, "quality overhead ns/row [%s]" % m,
+                    float(ov) <= float(cap),
+                    "%.1f <= %.1f" % (float(ov), float(cap)))
     factor = b.get("ns_per_row_p50_regression")
     cur = ((doc.get("ns_per_row") or {}).get("p50"))
     base = ((baseline_summary or {}).get("ns_per_row") or {}).get("p50") \
