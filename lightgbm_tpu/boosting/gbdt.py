@@ -37,6 +37,8 @@ from ..metric.metric import Metric, create_metrics
 from ..objective import ObjectiveFunction, create_objective
 from ..obs import active as _telemetry_active
 from ..obs import annotate as _annotate
+from ..obs import compile as _compile
+from ..obs import devmem as _devmem
 from ..obs import launches as _launches
 from ..obs import recompile as _recompile
 from ..obs import spans as _spans
@@ -1021,6 +1023,7 @@ class GBDT:
         key = (num_iters, self.shrinkage_rate, self.num_tree_per_iteration,
                len(self.valid_sets))
         fn = self._fused_cache.get(key)
+        chunk_compiled = fn is None
         if fn is None:
             try:
                 # _make_fused_train traces eagerly (_hoisted_jit runs
@@ -1078,17 +1081,24 @@ class GBDT:
         if tele is not None:
             self._record_chunk_telemetry(tele, first_iter,
                                          time.perf_counter() - t0,
-                                         fused=True)
+                                         fused=True,
+                                         compile_key="k=%d" % num_iters,
+                                         compiles=1 if chunk_compiled
+                                         else 0)
         if self.iter_ - self._last_poll >= self._poll_freq:
             return self._poll_stop()
         return False
 
     def _record_chunk_telemetry(self, tele, first_iter: int, dt: float,
-                                fused: bool) -> None:
+                                fused: bool, compile_key=None,
+                                compiles: int = 0) -> None:
         """Per-chunk metrics/events; the chunk is the host-work granularity
         of the async pipeline, so telemetry-off runs are untouched per
         iteration.  ``dt`` is the host DISPATCH wall (device completion is
-        async); end-to-end run walls come from the run driver's gauges."""
+        async); end-to-end run walls come from the run driver's gauges.
+        ``compile_key``/``compiles`` feed the compile accountant
+        (obs/compile.py): a chunk that traced a fresh fused program is
+        priced against the steady chunks that follow it."""
         iters = self.iter_ - first_iter
         if iters <= 0:
             return
@@ -1102,6 +1112,12 @@ class GBDT:
         tele.event("train_chunk", first_iter=int(first_iter),
                    iters=int(iters), dt_s=dt, rows_per_s=rate,
                    fused=bool(fused), bag_data_cnt=int(self.bag_data_cnt))
+        if compile_key is not None:
+            _compile.note_dispatch(tele, "fused_train", compile_key, dt,
+                                   int(compiles))
+        # HBM high-water stamp per chunk (obs/devmem.py): import-safe,
+        # quietly empty on backends without memory_stats
+        _devmem.sample(tele, phase="train_chunk")
         # span under the run trace: chunks line up as the training
         # lifeline in the Chrome-trace render (obs/spans.py)
         _spans.record_span(tele, "train_chunk", t0=time.time() - dt,

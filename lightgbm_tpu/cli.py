@@ -116,6 +116,13 @@ class Application:
                              metrics_addr=str(
                                  getattr(cfg, "metrics_addr", "")
                                  or "127.0.0.1"),
+                             alert_rules=str(
+                                 getattr(cfg, "alert_rules", "")
+                                 or "") or None,
+                             alert_interval_s=float(
+                                 getattr(cfg, "alert_interval_s", 1.0)),
+                             flight_recorder=bool(
+                                 getattr(cfg, "flight_recorder", False)),
                              entry="cli", task=str(cfg.task))
 
     @staticmethod

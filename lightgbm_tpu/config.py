@@ -286,6 +286,24 @@ class Config:
                         "endpoint is unauthenticated — make sure the "
                         "network perimeter covers it",
                         int(self.metrics_port), self.metrics_addr)
+        # round-16 forensics params: both ride the telemetry run — without
+        # one (telemetry_out or metrics_port) the drivers never configure
+        # obs and the arm silently does nothing, which is worth a warning
+        has_run = bool(str(self.telemetry_out or "")) \
+            or int(self.metrics_port) > 0
+        if str(self.alert_rules or ""):
+            import os as _os
+            if not _os.path.exists(str(self.alert_rules)):
+                Log.warning("alert_rules=%s does not exist; live alerting "
+                            "will be disabled", self.alert_rules)
+            if not has_run:
+                Log.warning("alert_rules is set but no telemetry run is "
+                            "configured (telemetry_out/metrics_port); the "
+                            "alert engine only runs on a telemetry run")
+        if bool(self.flight_recorder) and not has_run:
+            Log.warning("flight_recorder=true without a telemetry run "
+                        "(telemetry_out/metrics_port); no capture can be "
+                        "armed")
         if ("io_retry_attempts" in self.raw_params
                 or "io_retry_backoff_s" in self.raw_params):
             # the retry policy guards a process-global primitive

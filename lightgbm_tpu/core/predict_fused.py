@@ -47,6 +47,7 @@ import numpy as np
 from ..io.binning import BinType, MissingType
 from ..obs import active as _telemetry_active
 from ..obs import annotate as _annotate
+from ..obs import compile as _compile
 from ..obs import recompile as _recompile
 from ..utils.timer import FunctionTimer
 from .predict import (EnsembleArrays, _path_matrix, decide_raw,
@@ -389,6 +390,7 @@ class FusedPredictor:
                     [chunk, np.zeros((bucket - nc,) + chunk.shape[1:],
                                      dtype=chunk.dtype)])
             t0 = time.perf_counter()
+            misses = 0
             try:
                 with FunctionTimer("Predict::Fused(dispatch)"), \
                         _annotate("tree_block_predict"):
@@ -401,8 +403,8 @@ class FusedPredictor:
                 # is a recompile, attributed to this row bucket: the live
                 # form of the "steady-state serving never recompiles"
                 # invariant
-                _recompile.note_dispatch("predict_blocked", bucket,
-                                         predict_compile_count())
+                misses = _recompile.note_dispatch("predict_blocked", bucket,
+                                                  predict_compile_count())
             except Exception as exc:  # degraded serving: never an exception
                 out = self._predict_degraded(
                     jnp.asarray(chunk), bucket, exc,
@@ -414,6 +416,12 @@ class FusedPredictor:
                 tele.event("predict", rows=int(nc), bucket=int(bucket),
                            store=self.kind, trees=int(self.n_trees),
                            dt_s=dt, want_leaf=bool(want_leaf))
+                # compile accounting (obs/compile.py): every dispatch
+                # wall feeds the steady estimate; miss-bearing ones are
+                # priced against it (warm persistent-cache loads told
+                # apart from true compiles by their tiny excess)
+                _compile.note_dispatch(tele, "predict_blocked", bucket,
+                                       dt, misses)
             if want_leaf:
                 leaves[lo:lo + nc] = np.asarray(
                     out[1][:nc, :self.n_trees], dtype=np.int32)

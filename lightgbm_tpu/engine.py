@@ -177,6 +177,12 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             metrics_port=m_port,
             metrics_addr=str(getattr(booster.config, "metrics_addr", "")
                              or "127.0.0.1"),
+            alert_rules=str(getattr(booster.config, "alert_rules", "")
+                            or "") or None,
+            alert_interval_s=float(getattr(booster.config,
+                                           "alert_interval_s", 1.0)),
+            flight_recorder=bool(getattr(booster.config,
+                                         "flight_recorder", False)),
             entry="engine.train")
         own_tele = True
     else:
@@ -337,6 +343,14 @@ def serve(models, params: Optional[Dict[str, Any]] = None, **server_kwargs):
                                  metrics_addr=str(
                                      getattr(cfg, "metrics_addr", "")
                                      or "127.0.0.1"),
+                                 alert_rules=str(
+                                     getattr(cfg, "alert_rules", "")
+                                     or "") or None,
+                                 alert_interval_s=float(
+                                     getattr(cfg, "alert_interval_s", 1.0)),
+                                 flight_recorder=bool(
+                                     getattr(cfg, "flight_recorder",
+                                             False)),
                                  entry="engine.serve")
     server = None
     try:

@@ -40,7 +40,11 @@ from .trace import annotate
 __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "EVENT_SCHEMA_VERSION", "read_events", "iter_events",
            "validate_event", "shard_path", "configure", "active", "disable",
-           "annotate", "recompile", "spans", "quality"]
+           "annotate", "recompile", "spans", "quality",
+           "devmem", "profiling", "alerts"]
+# NOTE: the compile-accounting submodule is reachable as obs.compile but
+# deliberately NOT in __all__ — a star-import must not shadow the
+# builtin compile()
 
 _lock = threading.Lock()
 _active: Optional[Telemetry] = None
@@ -76,7 +80,10 @@ def _resolve_rank(rank: Optional[int]):
 
 def configure(out: Optional[str] = None, freq: int = 1,
               rank: Optional[int] = None, metrics_port: int = 0,
-              metrics_addr: str = "127.0.0.1", **meta: Any) -> Telemetry:
+              metrics_addr: str = "127.0.0.1",
+              alert_rules: Optional[str] = None,
+              alert_interval_s: float = 1.0,
+              flight_recorder: bool = False, **meta: Any) -> Telemetry:
     """Install the process-active telemetry run (closing any previous one).
 
     ``out`` is the JSONL sink path (None keeps events in memory); under a
@@ -101,6 +108,16 @@ def configure(out: Optional[str] = None, freq: int = 1,
     if int(metrics_port) > 0:
         from .exporter import start_exporter
         start_exporter(tele, port=int(metrics_port), addr=metrics_addr)
+    # performance-forensics plane (round 16): a rules file arms the live
+    # alert engine, flight_recorder arms the one-shot incident capture —
+    # both owned by the run and torn down by Telemetry.close()
+    if alert_rules:
+        from . import alerts as _alerts
+        _alerts.install(tele, rules_path=str(alert_rules),
+                        interval_s=float(alert_interval_s))
+    if flight_recorder:
+        from . import profiling as _profiling
+        _profiling.arm_flight_recorder(tele)
     return tele
 
 
@@ -124,3 +141,9 @@ def disable() -> None:
 # (configure, serving.Server, Telemetry.close) reach it lazily
 from . import spans  # noqa: E402,F401
 from . import quality  # noqa: E402,F401
+# forensics-plane modules (round 16): compile accounting and devmem are
+# light (stdlib + lazy jax touches); profiling and alerts are imported
+# lazily by their call sites like exporter — alerts only when a rules
+# file arms it, profiling only on capture/arm
+from . import compile  # noqa: E402,F401,A004
+from . import devmem  # noqa: E402,F401

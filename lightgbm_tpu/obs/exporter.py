@@ -90,7 +90,11 @@ def _esc_label(v) -> str:
 
 def render_prometheus(snapshot: Dict[str, Any],
                       run_recompiles: Optional[int] = None,
-                      quality: Optional[Dict[str, Any]] = None) -> str:
+                      quality: Optional[Dict[str, Any]] = None,
+                      compile_acct: Optional[Dict[str, Any]] = None,
+                      devmem_stats=None,
+                      residency: Optional[Dict[str, Any]] = None,
+                      alerts: Optional[Dict[str, Any]] = None) -> str:
     """Registry snapshot -> Prometheus text exposition (0.0.4).
 
     Counters render as ``counter``, gauges as ``gauge``, histograms as
@@ -101,7 +105,15 @@ def render_prometheus(snapshot: Dict[str, Any],
     process.  ``quality`` is a ``QualityMonitor.snapshot()``: per-model
     drift PSI per feature (already top-K bounded by the monitor, so a
     wide-F model cannot blow up the exposition), score PSI, generation and
-    freshness — the model-quality plane's labeled gauges."""
+    freshness — the model-quality plane's labeled gauges.
+
+    Forensics-plane blocks (round 16), each rendered only when its source
+    exists: ``compile_acct`` (an ``obs.compile`` snapshot — compile
+    wall-seconds per (fn, bucket) plus warm-load counts), ``devmem_stats``
+    (a live ``obs.devmem.sample`` result — per-device HBM gauges),
+    ``residency`` (``serving.registry.residency_snapshot()`` —
+    accounted-vs-actual resident bytes per model) and ``alerts`` (an
+    ``AlertEngine.snapshot()`` — per-rule firing gauges)."""
     from .. import resilience
     from ..utils.file_io import io_retry_count
     from . import launches, recompile
@@ -193,6 +205,76 @@ def render_prometheus(snapshot: Dict[str, Any],
                ['%s{model="%s"} %s' % (qr, lbl(m),
                                        _prom_val(info.get("rows")))
                 for m, info in sorted(models.items())])
+    # compile accounting (obs/compile.py): wall-seconds the run spent in
+    # XLA compiles, total and per (function, shape-bucket) — warm
+    # persistent-cache loads counted separately
+    if compile_acct:
+        ct = _PREFIX + "compile_seconds_total"
+        metric(ct, "counter",
+               ["%s %s" % (ct, _prom_val(
+                   compile_acct.get("compile_seconds_total", 0.0)))])
+        cs = _PREFIX + "compile_seconds"
+        cn = _PREFIX + "compiles_key_total"
+        key_samples, n_samples = [], []
+        for key, info in sorted((compile_acct.get("keys") or {}).items()):
+            fn_name, _, bucket = key.partition("|")
+            lab = '{fn="%s",bucket="%s"}' % (_esc_label(fn_name),
+                                            _esc_label(bucket))
+            key_samples.append("%s%s %s" % (cs, lab,
+                                            _prom_val(info.get("compile_s"))))
+            n_samples.append("%s%s %d" % (cn, lab,
+                                          int(info.get("compiles", 0))))
+        if key_samples:
+            metric(cs, "gauge", key_samples)
+            metric(cn, "counter", n_samples)
+        wl = _PREFIX + "compile_warm_loads_total"
+        metric(wl, "counter",
+               ["%s %d" % (wl, int(compile_acct.get("warm_loads", 0)))])
+    # device-memory telemetry (obs/devmem.py): live HBM occupancy per
+    # device — absent entirely on backends without memory_stats (CPU)
+    if devmem_stats:
+        for field, mname in (("bytes_in_use", "device_bytes_in_use"),
+                             ("peak_bytes_in_use", "device_peak_bytes"),
+                             ("largest_alloc_size",
+                              "device_largest_alloc_bytes"),
+                             ("bytes_limit", "device_bytes_limit")):
+            name = _PREFIX + mname
+            samples = ['%s{device="%s"} %s'
+                       % (name, _esc_label(dev), _prom_val(ms[field]))
+                       for dev, ms in devmem_stats if ms.get(field)
+                       is not None]
+            if samples:
+                metric(name, "gauge", samples)
+    # serving residency cross-check (obs/devmem.py + serving/registry.py):
+    # the registry's budget ledger vs the true stacked-ensemble bytes
+    if residency:
+        rb = _PREFIX + "residency_bytes"
+        samples = []
+        div_samples = []
+        rd = _PREFIX + "residency_divergence"
+        for m, info in sorted(residency.items()):
+            for kind_key in ("accounted", "actual"):
+                samples.append('%s{model="%s",kind="%s"} %s'
+                               % (rb, _esc_label(m), kind_key,
+                                  _prom_val(info.get(kind_key))))
+            if info.get("divergence") is not None:
+                div_samples.append('%s{model="%s"} %s'
+                                   % (rd, _esc_label(m),
+                                      _prom_val(info["divergence"])))
+        metric(rb, "gauge", samples)
+        if div_samples:
+            # labeled + rebuilt per scrape from LIVE models only: a
+            # departed model's divergence vanishes with it
+            metric(rd, "gauge", div_samples)
+    # live alerting (obs/alerts.py): one firing gauge per (rule, series)
+    if alerts and alerts.get("series"):
+        af = _PREFIX + "alert_state"
+        metric(af, "gauge",
+               ['%s{rule="%s",series="%s"} %d'
+                % (af, _esc_label(st.get("rule")),
+                   _esc_label(st.get("series")),
+                   1 if st.get("state") == "firing" else 0)
+                for st in alerts["series"]])
     return "\n".join(lines) + "\n"
 
 
@@ -270,7 +352,7 @@ class MetricsExporter:
                 self.wfile.write(data)
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 try:
                     if path == "/metrics":
                         self._send(200, exporter._metrics_text(),
@@ -285,6 +367,18 @@ class MetricsExporter:
                         self._send(200, json.dumps(
                             summarize(exporter.tele), default=str),
                             "application/json")
+                    elif path == "/alerts":
+                        from . import alerts as _alerts
+                        eng = _alerts.engine(exporter.tele)
+                        body = (eng.snapshot() if eng is not None
+                                else {"enabled": False, "series": [],
+                                      "firing": 0, "fired_total": 0})
+                        self._send(200, json.dumps(body, default=str),
+                                   "application/json")
+                    elif path == "/debug/profile":
+                        code, body = exporter._debug_profile(query)
+                        self._send(code, json.dumps(body, default=str),
+                                   "application/json")
                     else:
                         self._send(404, "not found: %s\n" % path,
                                    "text/plain")
@@ -307,15 +401,39 @@ class MetricsExporter:
         self._thread.start()
 
     def _metrics_text(self) -> str:
-        from . import recompile
+        from . import devmem, recompile
         snap = self.tele.registry.snapshot()
         base = getattr(self.tele, "recompile_baseline", {})
         run = sum(max(n - base.get(k, 0), 0)
                   for k, n in recompile.counts().items())
         mon = getattr(self.tele, "quality", None)
-        return render_prometheus(snap, run_recompiles=run,
-                                 quality=mon.snapshot()
-                                 if mon is not None else None)
+        acct = getattr(self.tele, "compile_acct", None)
+        eng = getattr(self.tele, "alerts", None)
+        # the scrape IS the devmem poll (live gauges cost nothing between
+        # scrapes) and the residency cross-check runs on the same cadence
+        dm = devmem.sample(self.tele)
+        residency = devmem.check_residency(self.tele)
+        return render_prometheus(
+            snap, run_recompiles=run,
+            quality=mon.snapshot() if mon is not None else None,
+            compile_acct=acct.snapshot() if acct is not None else None,
+            devmem_stats=dm, residency=residency,
+            alerts=eng.snapshot() if eng is not None else None)
+
+    def _debug_profile(self, query: str):
+        """GET /debug/profile?seconds=N: one bounded jax.profiler capture
+        into the run's artifact dir; 409 when one is already running."""
+        from urllib.parse import parse_qs
+        from . import profiling
+        try:
+            seconds = float(parse_qs(query).get(
+                "seconds", [profiling.DEFAULT_SECONDS])[0])
+        except (TypeError, ValueError):
+            return 400, {"error": "seconds must be a number"}
+        meta = profiling.capture(self.tele, seconds=seconds, reason="http")
+        if meta.get("busy"):
+            return 409, meta
+        return (200 if "error" not in meta else 501), meta
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -285,6 +285,16 @@ class Telemetry:
         # created lazily by quality.monitor(tele, create=True) — None on
         # runs that never serve/score traffic
         self.quality = None
+        # performance-forensics plane (round 16), all run-owned and all
+        # lazily created by their modules' create-on-first-use helpers:
+        # compile accounting (obs/compile.py), device-memory tracking
+        # (obs/devmem.py), profiler-capture state (obs/profiling.py) and
+        # the live alert engine (obs/alerts.py — the one with a thread;
+        # close() stops it with the run)
+        self.compile_acct = None
+        self.devmem = None
+        self.profiling = None
+        self.alerts = None
         self.freq = max(int(freq), 1)
         # newest-EVENT_BUFFER_CAP mirror of the JSONL stream (the file is
         # the durable record); event_count is the total ever recorded
@@ -358,11 +368,15 @@ class Telemetry:
                 self._fh.flush()
 
     def close(self) -> None:
-        # the exporter thread is stopped OUTSIDE the event lock (its
-        # in-flight handlers may be reading snapshots that briefly take it)
+        # the exporter and alert-engine threads are stopped OUTSIDE the
+        # event lock (their in-flight handlers/ticks may be reading
+        # snapshots — or emitting events — that briefly take it)
         exp, self.exporter = self.exporter, None
         if exp is not None:
             exp.stop()
+        eng, self.alerts = self.alerts, None
+        if eng is not None:
+            eng.stop()
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()

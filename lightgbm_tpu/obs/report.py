@@ -190,6 +190,32 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
     serving = serving_block(counters, gauges, hists)
     if serving is not None:
         out["serving"] = serving
+    # performance-forensics rollups (round 16), each present only when its
+    # run-owned state exists: compile wall-seconds per (fn, bucket) — the
+    # autotuner's ranking substrate — device-memory high-water, profiler
+    # captures and the live-alert tally
+    acct = getattr(tele, "compile_acct", None)
+    if acct is not None:
+        comp = acct.snapshot()
+        if comp:
+            out["compile"] = comp
+    from . import devmem as _devmem
+    dm = _devmem.snapshot(tele)
+    if dm:
+        out["devmem"] = dm
+    from . import profiling as _profiling
+    prof = _profiling.snapshot(tele)
+    if prof:
+        out["profiling"] = prof
+    eng = getattr(tele, "alerts", None)
+    if eng is not None:
+        out["alerts"] = eng.snapshot()
+    elif snap["counters"].get("alerts_fired"):
+        # out-of-band incidents (watchdog stall without an engine) still
+        # surface a tally so perf_gate's alerts_fired budget sees them
+        out["alerts"] = {"enabled": False, "series": [],
+                         "fired_total": int(snap["counters"]
+                                            ["alerts_fired"])}
     # model-quality rollup (obs/quality.py): per-model drift PSI/JS ranked
     # by importance, score PSI, generation + freshness — present only when
     # the run monitored traffic
@@ -279,6 +305,50 @@ def human_table(summary: Dict[str, Any]) -> str:
                     "psi=%.4f js=%.4f imp=%.4f"
                     % (f.get("psi", 0.0), f.get("js", 0.0),
                        f.get("importance", 0.0)))
+    comp = summary.get("compile") or {}
+    if comp.get("keys"):
+        lines.append("  compile:")
+        row("    compile_seconds_total",
+            "%.6g (compiles %d, warm loads %d%s)"
+            % (comp.get("compile_seconds_total", 0.0),
+               comp.get("compiles", 0), comp.get("warm_loads", 0),
+               (", unresolved %d" % comp["unresolved"])
+               if comp.get("unresolved") else ""))
+        for key, info in sorted(comp["keys"].items()):
+            steady = info.get("steady_p50_s")
+            row("    %s" % key,
+                "n=%d warm=%d compile_s=%.6g steady_p50=%s"
+                % (info.get("compiles", 0), info.get("warm_loads", 0),
+                   info.get("compile_s", 0.0),
+                   "-" if steady is None else "%.6g" % steady))
+    dm = summary.get("devmem") or {}
+    if dm.get("devices"):
+        lines.append("  devmem:")
+        row("    peak_bytes_max", "%d" % dm.get("peak_bytes_max", 0))
+        for dev, ms in sorted(dm["devices"].items()):
+            row("    device %s" % dev,
+                " ".join("%s=%d" % (k, v) for k, v in sorted(ms.items())))
+    al = summary.get("alerts") or {}
+    if al:
+        lines.append("  alerts:")
+        row("    fired_total", "%d%s"
+            % (al.get("fired_total", 0),
+               "" if al.get("enabled", True) else " (no engine: "
+               "out-of-band incidents only)"))
+        for st in al.get("series") or []:
+            if st.get("state") == "firing" or st.get("fired"):
+                row("    %s[%s]" % (st.get("rule"), st.get("series", "-")),
+                    "%s value=%s fast=%s slow=%s"
+                    % (st.get("state", "?"), st.get("value", "-"),
+                       st.get("fast_burn", "-"), st.get("slow_burn", "-")))
+        for name, n in sorted((al.get("external") or {}).items()):
+            row("    external %s" % name, "%d" % n)
+    prof = summary.get("profiling") or {}
+    if prof.get("captures"):
+        lines.append("  profiler captures:")
+        for c in prof["captures"]:
+            row("    #%d %s" % (c.get("n", 0), c.get("reason", "?")),
+                c.get("error") or c.get("dir", "-"))
     res = summary.get("resilience") or {}
     shown = {k: v for k, v in sorted(res.items())
              if (isinstance(v, (int, float)) and v)
@@ -367,6 +437,10 @@ def finalize_run(tele: Telemetry, gbdt=None, wall_s: Optional[float] = None,
         if fi is not None:
             extra = dict(extra or {})
             extra.setdefault("feature_importance", fi)
+    # one final devmem poll so the summary's high-water covers the whole
+    # run even when no exporter ever scraped (quietly empty on CPU)
+    from . import devmem as _devmem
+    _devmem.sample(tele, phase="finalize")
     summary = summarize(tele, extra=extra)
     tele.event("run_end", wall_s=wall_s, iterations=iters)
     path = summary_path

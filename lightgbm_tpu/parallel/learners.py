@@ -165,19 +165,32 @@ def sharded_predict(ens, rows: np.ndarray, mesh: Optional[Mesh] = None, *,
                     ens, jnp.asarray(chunk),
                     early_stop_margin=float(early_stop_margin),
                     round_period=int(round_period))
+        misses = 0
         if not fell_back:
             # one jitted fn per (mesh, early-stop config), each with its OWN
             # jit cache growing from zero: watch them separately (by callable
             # identity — fns are cached for the process lifetime) so a second
             # mesh's compiles aren't swallowed by the first's larger baseline
-            _recompile.note_dispatch(
+            misses = _recompile.note_dispatch(
                 "sharded_predict(m=%g,p=%d)" % (early_stop_margin,
                                                 round_period),
                 bucket, fn._cache_size(), watch="sharded_predict/%d" % id(fn))
         if tele is not None:
+            dt = _time.perf_counter() - t0
             tele.event("sharded_predict", rows=int(nc), bucket=int(bucket),
-                       shards=int(d), dt_s=_time.perf_counter() - t0,
-                       fallback=bool(fell_back))
+                       shards=int(d), dt_s=dt, fallback=bool(fell_back))
+            if not fell_back:
+                # compile accounting (obs/compile.py): the sharded path's
+                # compiles are priced like the single-device ones.  The key
+                # carries the early-stop config AND the shard count — two
+                # meshes (or two configs) have different steady walls, and
+                # pricing one config's compile against the other's steady
+                # median would corrupt the autotuner substrate
+                from ..obs import compile as _compile
+                _compile.note_dispatch(
+                    tele, "sharded_predict(m=%g,p=%d,d=%d)"
+                    % (early_stop_margin, round_period, d),
+                    bucket, dt, misses)
         scores[lo:lo + nc] = np.asarray(out[:nc], dtype=np.float64)
     return scores
 

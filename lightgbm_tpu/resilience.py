@@ -368,6 +368,16 @@ class Watchdog:
             tele.gauge("watchdog_stall_s").set(elapsed)
             tele.event("watchdog_stall", section=name, stall_s=elapsed,
                        timeout_s=self.timeout_s)
+            # a stall is an SLO incident: surface it through the alert
+            # stream (obs/alerts.py) and give the flight recorder its one
+            # shot BEFORE the abort — the capture runs synchronously here
+            # so the artifact exists when the supervisor reads exit 79.
+            # Both are no-ops unless the run armed them.
+            from .obs import alerts as _alerts
+            from .obs import profiling as _profiling
+            _alerts.note_incident(tele, "watchdog_stall", section=name,
+                                  stall_s=elapsed)
+            _profiling.on_incident("watchdog_stall")
             tele.flush()
         if self.artifact:
             try:

@@ -34,6 +34,7 @@ from __future__ import annotations
 import re
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -44,6 +45,36 @@ from ..obs import active as _telemetry_active
 from ..utils.log import LightGBMError, Log
 
 DEFAULT_BUDGET_MB = 1024.0
+
+# every live ModelRegistry, for the process-wide residency exposition
+# (obs/devmem.check_residency + the /metrics lgbm_tpu_residency_bytes
+# gauges); weak so a dropped registry vanishes from the scrape with no
+# close() protocol
+_REGISTRIES: "weakref.WeakSet" = weakref.WeakSet()
+_REG_SEQ = 0
+_REG_SEQ_LOCK = threading.Lock()
+
+
+def residency_snapshot() -> Dict[str, Dict[str, int]]:
+    """Accounted-vs-actual resident bytes per model across every live
+    registry: ``{model: {"accounted": n, "actual": n}}``.  ``accounted``
+    is what the budget ledger charged (admission + counted growth),
+    ``actual`` the true stacked-ensemble bytes — the footprint note at
+    :class:`ResidentModel` as a scrapeable invariant.  Two registries
+    holding one name stay distinct (``name``, ``name#2``) and STABLE:
+    registries are walked in creation order (a WeakSet's iteration order
+    is not — a same-name collision resolved by set order would let the
+    per-model gauges and the warn-once ledger swap registries between
+    scrapes)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for reg in sorted(_REGISTRIES, key=lambda r: r._reg_seq):
+        for name, info in reg.residency_stats().items():
+            key, n = _safe_name(name), 1
+            while key in out:
+                n += 1
+                key = "%s#%d" % (_safe_name(name), n)
+            out[key] = info
+    return out
 
 
 def _safe_name(name: str) -> str:
@@ -261,6 +292,11 @@ class ModelRegistry:
         # eviction/park/re-admission so a readmitted model keeps its
         # generation; swap() bumps under the SAME lock as the name flip
         self._generations: Dict[str, int] = {}
+        global _REG_SEQ
+        with _REG_SEQ_LOCK:
+            _REG_SEQ += 1
+            self._reg_seq = _REG_SEQ
+        _REGISTRIES.add(self)
 
     def _note_fallback(self, site: str) -> None:
         with self._lock:
@@ -621,6 +657,15 @@ class ModelRegistry:
         if parked is None:
             return (-1.0, 10), False
         return parked[0]._predict_early_stop(), early_stop_allowed(parked[0])
+
+    def residency_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-resident-model accounted-vs-actual bytes (one lock
+        round-trip; parked models hold no arrays and are omitted) — the
+        source of :func:`residency_snapshot`."""
+        with self._lock:
+            return {n: {"accounted": int(e.accounted_bytes),
+                        "actual": int(e.resident_bytes)}
+                    for n, e in self._resident.items()}
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

@@ -384,6 +384,26 @@ def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch, tmp_path):
     monkeypatch.setattr(
         obs_quality.QualityBaseline, "from_model",
         classmethod(lambda cls, *a, **k: calls.append(("baseline", a))))
+    # forensics plane (round 16): zero accountant/tracker/state/engine
+    # constructions, zero notes/samples/captures with telemetry off
+    from lightgbm_tpu.obs import alerts as obs_alerts
+    from lightgbm_tpu.obs import compile as obs_compile
+    from lightgbm_tpu.obs import devmem as obs_devmem
+    from lightgbm_tpu.obs import profiling as obs_profiling
+    monkeypatch.setattr(obs_compile.CompileAccounting, "__init__",
+                        lambda self, *a, **k: calls.append(
+                            ("CompileAccounting", a)))
+    monkeypatch.setattr(obs_compile, "note_dispatch",
+                        lambda *a, **k: calls.append(("compile_note", a)))
+    monkeypatch.setattr(obs_devmem, "sample",
+                        lambda *a, **k: calls.append(("devmem", a)))
+    monkeypatch.setattr(obs_profiling, "capture",
+                        lambda *a, **k: calls.append(("capture", a)))
+    monkeypatch.setattr(obs_alerts.AlertEngine, "__init__",
+                        lambda self, *a, **k: calls.append(
+                            ("AlertEngine", a)))
+    monkeypatch.setattr(obs_alerts, "note_incident",
+                        lambda *a, **k: calls.append(("incident", a)))
     assert obs.active() is None
     booster, X, _ = _toy_booster(num_iterations=8)
     booster.train_chunk(8)

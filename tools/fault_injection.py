@@ -60,6 +60,12 @@ Scenarios (each prints PASS/FAIL and exits nonzero on failure):
                above the alert threshold, the generation gauge flips with
                the swap, zero drops, zero steady-state recompiles, and the
                quality block survives died-run recovery from raw events.
+  stall-capture  The round-16 flight recorder under the hang drill: the
+               watchdog stall, with a telemetry run and flight_recorder
+               armed, emits a kind="alert" event, triggers EXACTLY ONE
+               jax.profiler capture artifact (written BEFORE the abort so
+               a supervisor reading exit 79 finds the evidence), and the
+               exit code stays EXIT_STALLED.
   all          Run every scenario.
 
 ``--matrix`` runs every scenario, prints a pass/fail table, and writes a
@@ -900,7 +906,72 @@ def scenario_drift_swap(workdir: str) -> None:
                       PSI_ALERT))
 
 
+# ---- stall-capture: the round-16 flight recorder under the hang drill ----
+
+_STALL_CAPTURE_CHILD_SRC = _TRAIN_SRC + r"""
+# the hang scenario with the forensics plane armed: a telemetry run with
+# the flight recorder on.  The watchdog stall must emit an alert event,
+# trigger EXACTLY ONE profiler capture (synchronously, BEFORE the abort,
+# so the artifact exists when the supervisor reads exit 79), and still
+# exit EXIT_STALLED.
+import time
+from lightgbm_tpu import obs, resilience
+
+booster = build(12, -1)
+tele = obs.configure(out=os.environ["TELE_OUT"], flight_recorder=True)
+resilience.start_watchdog(float(os.environ["WD_TIMEOUT"]),
+                          artifact=os.environ["STALL_ARTIFACT"])
+booster.train_chunk(4)  # healthy: compiles + caches + completes a section
+for key in list(booster._fused_cache):
+    booster._fused_cache[key] = lambda *a, **k: time.sleep(3600)
+print("ARMED", flush=True)
+booster.train()  # hangs; watchdog -> alert + capture + EXIT_STALLED
+print("UNREACHABLE")
+"""
+
+
+def scenario_stall_capture(workdir: str) -> None:
+    """Watchdog fire with the flight recorder armed: capture artifact
+    exists, alert event emitted, exit 79 unchanged."""
+    import glob as _glob
+
+    from lightgbm_tpu.obs import read_events
+    from lightgbm_tpu.resilience import EXIT_STALLED
+    tele_out = os.path.join(workdir, "stallcap.jsonl")
+    art = os.path.join(workdir, "stallcap_stall.json")
+    p = _run_child(_STALL_CAPTURE_CHILD_SRC, {
+        "WD_TIMEOUT": "2.0", "STALL_ARTIFACT": art, "TELE_OUT": tele_out})
+    assert p.returncode == EXIT_STALLED, \
+        "expected exit %d (stalled), got %r: %s" % (
+            EXIT_STALLED, p.returncode, p.stdout + p.stderr[-2000:])
+    assert "UNREACHABLE" not in p.stdout
+    assert os.path.exists(art), "stall diagnostics missing"
+    # EXACTLY ONE capture artifact, in the run-scoped layout, with its
+    # metadata stamp (the flight recorder is one-shot)
+    caps = _glob.glob(os.path.join(tele_out + ".profiles", "capture_*"))
+    assert len(caps) == 1, "expected 1 capture artifact, got %r" % caps
+    assert os.path.exists(os.path.join(caps[0], "capture.json")), caps[0]
+    # the torn-tail-tolerant event stream carries the whole incident:
+    # stall -> alert -> capture
+    kinds = {}
+    alert = None
+    for e in read_events(tele_out):
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+        if e["kind"] == "alert" and alert is None:
+            alert = e
+    for kind in ("watchdog_stall", "alert", "profile_capture"):
+        assert kinds.get(kind), "no %r event in %s (%r)" % (kind, tele_out,
+                                                            kinds)
+    assert alert["rule"] == "watchdog_stall" \
+        and alert["state"] == "firing", alert
+    assert kinds["profile_capture"] == 1, kinds
+    print("PASS stall-capture: watchdog stall emitted the alert event, "
+          "fired exactly one flight-recorder capture (%s) and exited %d"
+          % (os.path.basename(caps[0]), EXIT_STALLED))
+
+
 SCENARIOS = {"kill-write": scenario_kill_write,
+             "stall-capture": scenario_stall_capture,
              "swap-under-load": scenario_swap_under_load,
              "drift-swap": scenario_drift_swap,
              "level-preempt": scenario_level_preempt,

@@ -160,6 +160,14 @@ def summary_from_events(events):
                  "watchdog_stall": "watchdog_stalls",
                  "elastic_resume": "elastic_resumes"}
     resilience = {}
+    # forensics recovery (round 16): kind="compile" breadcrumbs rebuild the
+    # compile section (recovered compile_s is the raw miss-bearing dispatch
+    # wall — an upper bound; the steady subtraction died with the process),
+    # kind="alert" transitions rebuild the fired tally per rule
+    compile_keys = {}
+    alert_rules = {}
+    alerts_fired = 0
+    captures = []
     n_events = 0
     for e in events:
         n_events += 1
@@ -188,6 +196,27 @@ def summary_from_events(events):
             # several programs in one dispatch)
             key = "%s|%s" % (e.get("fn", "?"), e.get("bucket", "?"))
             recompiles[key] = recompiles.get(key, 0) + int(e.get("n", 1))
+        if e["kind"] == "compile":
+            key = "%s|%s" % (e.get("fn", "?"), e.get("bucket", "?"))
+            agg = compile_keys.setdefault(key, {"compiles": 0,
+                                                "compile_s": 0.0})
+            agg["compiles"] += int(e.get("n", 1))
+            agg["compile_s"] += float(e.get("dispatch_s", 0.0) or 0.0)
+        if e["kind"] == "alert":
+            rule = str(e.get("rule", "?"))
+            agg = alert_rules.setdefault(rule, {"fired": 0,
+                                                "last_state": None})
+            if e.get("state") == "firing":
+                agg["fired"] += 1
+                alerts_fired += 1
+            agg["last_state"] = e.get("state")
+            agg["series"] = e.get("series")
+            if e.get("severity") is not None:
+                agg["severity"] = e.get("severity")
+        if e["kind"] == "profile_capture":
+            captures.append({k: e.get(k) for k in
+                             ("n", "reason", "dir", "seconds", "error")
+                             if e.get(k) is not None})
         if e["kind"] == "serve_batch":
             m = str(e.get("model", "?"))
             for ck, n in (("serve_batches", 1),
@@ -273,9 +302,34 @@ def summary_from_events(events):
             q_models[m] = entry
     quality = ({"models": q_models, "generations": q_gens}
                if q_models else None)
+    compile_block = None
+    if compile_keys:
+        compile_block = {
+            # the raw miss-bearing dispatch walls: an UPPER bound on the
+            # compile seconds (no steady baseline survives a dead process)
+            "compile_seconds_total": round(
+                sum(v["compile_s"] for v in compile_keys.values()), 6),
+            "compiles": sum(v["compiles"] for v in compile_keys.values()),
+            "recovered": True,
+            "keys": {k: {"compiles": v["compiles"],
+                         "compile_s": round(v["compile_s"], 6)}
+                     for k, v in sorted(compile_keys.items())},
+        }
+    alerts_block = None
+    if alert_rules or alerts_fired:
+        alerts_block = {
+            "enabled": True, "recovered": True,
+            "fired_total": alerts_fired,
+            "series": [{"rule": r, "state": info.get("last_state"), **info}
+                       for r, info in sorted(alert_rules.items())],
+        }
     return {
         **({"serving": serving} if serving else {}),
         **({"quality": quality} if quality else {}),
+        **({"compile": compile_block} if compile_block else {}),
+        **({"alerts": alerts_block} if alerts_block else {}),
+        **({"profiling": {"captures": captures, "recovered": True}}
+           if captures else {}),
         "resilience": resilience,
         "metric": "telemetry_run", "unit": "row-trees/s", "value": None,
         "iterations": None, "wall_s": None,

@@ -25,7 +25,12 @@ budgets:
 - quality-plane budgets — a monitor-on serving summary keeps
   ``serving.dropped == 0`` (plus the recompile gauge above) and every
   model's ``quality.*.overhead_ns_per_row`` under
-  ``quality_overhead_ns_per_row_max``.
+  ``quality_overhead_ns_per_row_max``;
+- forensics budgets (round 16) — a summary carrying an ``alerts``
+  section fired at most ``alerts_fired_max`` live alerts (0: a healthy
+  baseline never pages), and its ``compile.compile_seconds_total`` may
+  not exceed the committed telemetry baseline's by more than
+  ``compile_seconds_regression``.
 
 Artifact type is sniffed from its keys (telemetry summary / bench-serve
 grid / split-cost / bench.py wrapper), so one invocation can gate a mixed
@@ -156,7 +161,7 @@ def gate_bench_line(g: Gate, path: str, doc: dict, b: dict) -> None:
 
 
 def gate_summary(g: Gate, path: str, doc: dict, b: dict,
-                 baseline_summary) -> None:
+                 baseline_summary, forensics_baseline=None) -> None:
     gauges = doc.get("gauges") or {}
     # bench self-recording runs carry the timed-window gauge; plain runs
     # include warmup compiles, where a zero bar would be meaningless
@@ -204,6 +209,32 @@ def gate_summary(g: Gate, path: str, doc: dict, b: dict,
                 % (float(cur), float(base), float(factor)))
     elif factor and cur is not None:
         g.skip(path, "ns/row p50 regression", "no telemetry baseline")
+    # forensics budgets (round 16): a healthy baseline artifact fired
+    # zero live alerts, and its compile wall-seconds may not regress
+    # beyond the declared factor (a kernel change that doubles compile
+    # time is a real cost the autotuner data must not silently absorb)
+    al = doc.get("alerts")
+    if al is not None:
+        g.check(path, "alerts fired", int(al.get("fired_total", 0))
+                <= int(b.get("alerts_fired_max", 0)),
+                "fired_total=%s" % al.get("fired_total", 0))
+    # the compile factor compares against the dedicated forensics
+    # baseline (a run recorded WITH warmup compiles in frame); the
+    # ns/row baseline above stays reserved for a steady-state BENCH
+    # artifact — the two are different regimes by construction
+    cfac = b.get("compile_seconds_regression")
+    ccur = (doc.get("compile") or {}).get("compile_seconds_total")
+    cmp_base = forensics_baseline or baseline_summary
+    cbase = ((cmp_base or {}).get("compile")
+             or {}).get("compile_seconds_total") if cmp_base else None
+    if cfac and ccur is not None and cbase:
+        g.check(path, "compile seconds regression",
+                float(ccur) <= float(cbase) * float(cfac),
+                "%.4gs vs baseline %.4gs (%.2fx bar)"
+                % (float(ccur), float(cbase), float(cfac)))
+    elif cfac and ccur is not None:
+        g.skip(path, "compile seconds regression",
+               "no telemetry baseline with a compile section")
 
 
 def run_gate(artifacts, budgets_path: str) -> int:
@@ -216,6 +247,7 @@ def run_gate(artifacts, budgets_path: str) -> int:
     b = spec.get("budgets") or {}
     serve_baseline, _ = _baseline(budgets_path, spec, "serve")
     tele_baseline, _ = _baseline(budgets_path, spec, "telemetry")
+    forensics_baseline, _ = _baseline(budgets_path, spec, "forensics")
     if not artifacts:
         # default: gate the committed baseline artifacts themselves (the
         # self-consistency run CI uses)
@@ -246,7 +278,8 @@ def run_gate(artifacts, budgets_path: str) -> int:
         elif kind == "split_cost":
             gate_split_cost(g, path, doc, b)
         elif kind == "summary":
-            gate_summary(g, path, doc, b, tele_baseline)
+            gate_summary(g, path, doc, b, tele_baseline,
+                         forensics_baseline=forensics_baseline)
         elif kind == "bench_line":
             gate_bench_line(g, path, doc, b)
         else:

@@ -12,9 +12,12 @@ Usage:
     python tools/profile_tree.py [rows] [leaves] [max_bin]   # tree build
     python tools/profile_tree.py --chunk [rows] [leaves]     # fused chunk
 
-Writes the trace under /tmp/lgbm_tpu_prof and prints the top ops by total
-device time, grouped by op name with counts — the numbers recorded in
-PERF.md.
+Captures through the ``lightgbm_tpu.obs.profiling`` layout (``--out``
+root, default /tmp/lgbm_tpu_prof, one ``capture_<n>_profile_tree/`` dir
+with a ``capture.json`` per invocation) — the SAME artifact shape the
+triggered path (``/debug/profile``, the flight recorder) produces, so
+this aggregation works on either.  Prints the top ops by total device
+time, grouped by op name with counts — the numbers recorded in PERF.md.
 """
 import collections
 import glob
@@ -70,6 +73,9 @@ def main() -> None:
     ap.add_argument("--nsrow", action="store_true",
                     help="also print per-op device time per logical "
                          "row-visit (PERF.md per-phase unit)")
+    ap.add_argument("--out", default="/tmp/lgbm_tpu_prof",
+                    help="capture root (obs/profiling layout: one "
+                         "capture_<n>_profile_tree/ dir per invocation)")
     cli = ap.parse_args()
     import jax
     import jax.numpy as jnp
@@ -89,7 +95,13 @@ def main() -> None:
     ds = BinnedDataset.from_matrix(X, label=y, max_bin=max_bin)
     cfg = Config(objective="binary", num_leaves=leaves, max_bin=max_bin,
                  num_iterations=100)
-    trace_dir = "/tmp/lgbm_tpu_prof"
+    # the shared capture layout (obs/profiling.py): the standalone tool and
+    # the triggered /debug/profile path produce identically-shaped
+    # artifacts, so aggregate_xplane works on both
+    from lightgbm_tpu.obs import profiling
+    root = cli.out
+    seq = len(glob.glob(os.path.join(root, "capture_*"))) + 1
+    trace_dir = profiling.open_capture(root, seq, "profile_tree")
 
     if chunk:
         from lightgbm_tpu.boosting.gbdt import GBDT
@@ -106,9 +118,12 @@ def main() -> None:
         b.train_chunk(3)
         sync()
         print("fused chunk: %.1f ms/iter" % ((time.perf_counter() - t0) / 3 * 1e3))
-        with jax.profiler.trace(trace_dir):
+        with profiling.trace_block(trace_dir):
             b.train_chunk(3)
             sync()
+        profiling.write_meta(trace_dir, reason="profile_tree",
+                             mode="chunk", rows=n, leaves=leaves,
+                             max_bin=max_bin)
     else:
         from lightgbm_tpu.core.tree_learner import SerialTreeLearner
         lrn = SerialTreeLearner(ds, cfg)
@@ -121,9 +136,12 @@ def main() -> None:
             arr = lrn.train(g, h, n)
         int(arr.num_leaves)
         print("tree build: %.1f ms" % ((time.perf_counter() - t0) / 3 * 1e3))
-        with jax.profiler.trace(trace_dir):
+        with profiling.trace_block(trace_dir):
             arr = lrn.train(g, h, n)
             int(arr.num_leaves)
+        profiling.write_meta(trace_dir, reason="profile_tree",
+                             mode="tree", rows=n, leaves=leaves,
+                             max_bin=max_bin)
 
     # --nsrow: also print each op's device time per LOGICAL row-visit, the
     # unit PERF.md's per-phase table uses.  Row-visits are exact from the
