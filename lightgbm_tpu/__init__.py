@@ -9,7 +9,7 @@ from . import obs
 from .basic import Booster, Dataset
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)
-from .engine import CVBooster, cv, serve, train
+from .engine import CVBooster, cv, serve, serve_and_train, train
 from .utils.log import LightGBMError
 
 try:
@@ -26,7 +26,7 @@ except ImportError:  # pragma: no cover
 __version__ = "2.3.2"
 
 __all__ = ["Dataset", "Booster", "CVBooster", "LightGBMError",
-           "train", "cv", "serve", "obs",
+           "train", "cv", "serve", "serve_and_train", "obs",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "early_stopping", "print_evaluation", "record_evaluation",
            "reset_parameter", "EarlyStopException",

@@ -1587,6 +1587,43 @@ class GBDT:
         from ..checkpoint import restore_checkpoint
         return restore_checkpoint(self, prefix)
 
+    def warm_start_continuation(self, model_str: Optional[str] = None,
+                                train_data: Optional[BinnedDataset] = None,
+                                objective=None) -> int:
+        """Bind this booster to continue a published model — the online
+        loop's warm-start contract (never-from-scratch).
+
+        Loads ``model_str`` when given (else keeps the already-loaded
+        model), rebinds to ``train_data`` with a blocked binned score
+        replay, and — the contract — aligns the training clock to the
+        loaded iteration count: ``iter_`` continues ABSOLUTE, so the
+        stateless bagging hash (``_bag_uniforms`` keyed by iteration, on
+        both the per-iteration and the fused in-scan path) and the
+        config-keyed chunk partitioning reproduce exactly the masks and
+        programs the uninterrupted run would have used.  That is what
+        makes ``train(k)`` → publish → continue-to-``k+m`` byte-identical
+        to the checkpoint-resume path at the same boundary
+        (tests/test_online.py pins it with bagging on).
+
+        Returns the aligned iteration."""
+        if model_str is not None:
+            self.load_model_from_string(model_str)
+        ds = train_data if train_data is not None else self.train_data
+        if ds is None:
+            raise LightGBMError("warm_start_continuation needs a training "
+                                "dataset to bind the continuation to")
+        self.reset_training_data(ds, objective if objective is not None
+                                 else self.objective)
+        self.replay_train_score()
+        # align to the ENSEMBLE, not just num_init_iteration: an
+        # in-process-trained booster being rebound to a new window has
+        # num_init_iteration == 0 but k trees — rewinding its clock to 0
+        # would replay bagging iterations the trees already consumed
+        self.iter_ = max(int(self.num_init_iteration),
+                         len(self._models)
+                         // max(self.num_tree_per_iteration, 1))
+        return self.iter_
+
     def _renew_tree_output(self, tree: Tree, arrays: TreeArrays,
                            class_id: int) -> TreeArrays:
         """Per-leaf output renewal for percentile objectives

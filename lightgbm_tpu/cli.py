@@ -91,6 +91,8 @@ class Application:
             self.refit()
         elif task == "serve":
             self.serve()
+        elif task == "online":
+            self.online()
         else:
             Log.fatal("Unknown task: %s", task)
 
@@ -146,7 +148,7 @@ class Application:
         from . import resilience
         cfg = self.config
         preempt = bool(getattr(cfg, "preemption_checkpoint", False)) \
-            and cfg.task == "train"
+            and cfg.task in ("train", "online")
         base = (str(getattr(cfg, "telemetry_out", "") or "")
                 or cfg.output_model or None)
         return resilience.arm_supervision(
@@ -361,6 +363,108 @@ class Application:
                     "serve_wall_s": time.perf_counter() - t_start})
                 obs.disable()
         finally:
+            self._disarm_resilience(preempt, own_wd)
+            self._close_telemetry(tele)
+
+    # ---- task=online (the round-17 train-while-serve loop) ----
+
+    def online(self) -> None:
+        """One process that serves and trains: bootstrap (or load) a model
+        over ``data``, start the serving tier + online trainer
+        (lightgbm_tpu/online), then replay ``online_feed`` — a labeled
+        file binned against the training layout — as BOTH serving
+        requests and trainer ingest.  Scores land in ``output_result``
+        (request order), every published generation is persisted to
+        ``output_model``, and the cycle checkpoints ride the same prefix
+        so a SIGTERM exits ``EXIT_PREEMPTED`` (75) and a rerun resumes
+        the interrupted cycle before continuing the feed."""
+        import time
+        cfg = self.config
+        tele = self._configure_telemetry()
+        preempt, own_wd = self._arm_resilience()
+        t_start = time.perf_counter()
+        controller = None
+        try:
+            from .online import OnlineController
+            from .resilience import EXIT_PREEMPTED, TrainingPreempted
+            from .serving import Server
+            loader = DatasetLoader(cfg)
+            train_data = loader.load_from_file(cfg.data)
+            Log.info("Finished loading data: %d rows, %d features",
+                     train_data.num_data, train_data.num_features)
+            objective = create_objective(cfg.objective, cfg)
+            booster = create_boosting(cfg.boosting, cfg, train_data,
+                                      objective)
+            if cfg.input_model:
+                with open(cfg.input_model) as fh:
+                    booster.load_model_from_string(fh.read())
+                # the controller's warm-start binding replays the loaded
+                # model onto the training scores and aligns the clock
+            else:
+                booster.train()  # bootstrap: num_iterations rounds
+            server = Server(config=cfg)
+            prefix = cfg.output_model or None
+            try:
+                controller = OnlineController(
+                    server=server, name="model", booster=booster,
+                    base_ds=train_data, config=cfg,
+                    checkpoint_prefix=prefix, publish_out=prefix)
+                controller.start()
+            except BaseException:
+                server.close(drain=False)
+                raise
+            futures = []
+            if getattr(cfg, "online_feed", ""):
+                feed = loader.load_from_file(cfg.online_feed,
+                                             reference=train_data)
+                if feed.raw_data is None:
+                    Log.fatal("online_feed must load with raw values "
+                              "(dense input) to replay as requests")
+                Xf = np.asarray(feed.raw_data, dtype=np.float32)
+                yf = np.asarray(feed.metadata.label, dtype=np.float64)
+                step = max(1, min(256, len(Xf) // 8 or 1))
+                for lo in range(0, len(Xf), step):
+                    if controller.preempted is not None:
+                        break
+                    futures.append(controller.submit(
+                        Xf[lo:lo + step],
+                        raw_score=bool(cfg.predict_raw_score)))
+                    controller.ingest(Xf[lo:lo + step].astype(np.float64),
+                                      yf[lo:lo + step])
+                controller.flush(timeout=600.0)
+            outs = [f.result() for f in futures]
+            try:
+                # surfaces a TrainingPreempted the trainer thread caught
+                controller.wait(timeout=0.0)
+            except TrainingPreempted as exc:
+                # serving drained (accepted requests all completed above);
+                # the emergency checkpoint + window are on disk: exit with
+                # the distinct resumable code
+                controller.close(drain=True)
+                Log.warning("%s; exiting with code %d (resumable)", exc,
+                            EXIT_PREEMPTED)
+                raise SystemExit(EXIT_PREEMPTED)
+            out = (np.concatenate([np.atleast_1d(o) for o in outs])
+                   if outs else np.zeros(0))
+            self._write_result(cfg.output_result, out)
+            st = controller.stats()
+            if st["serving"]["dropped"]:
+                Log.fatal("online replay dropped %d requests",
+                          st["serving"]["dropped"])
+            Log.info("Online run: %d cycles (%d generations), %d rows "
+                     "ingested, %d served requests, results in %s",
+                     st["cycles"], st["generation"], st["rows_ingested"],
+                     st["serving"]["submitted"], cfg.output_result)
+            if tele is not None:
+                from . import obs
+                from .obs.report import finalize_run
+                finalize_run(tele, gbdt=controller.booster,
+                             wall_s=time.perf_counter() - t_start,
+                             extra={"online_cli": st["cycles"]})
+                obs.disable()
+        finally:
+            if controller is not None:
+                controller.close()
             self._disarm_resilience(preempt, own_wd)
             self._close_telemetry(tele)
 

@@ -79,7 +79,9 @@ _TASK_ALIASES = {"train": "train", "training": "train",
                  "predict": "predict", "prediction": "predict", "test": "predict",
                  "convert_model": "convert_model",
                  "refit": "refit", "refit_tree": "refit",
-                 "serve": "serve", "serving": "serve"}
+                 "serve": "serve", "serving": "serve",
+                 "online": "online", "serve_and_train": "online",
+                 "train_while_serve": "online"}
 
 _TREE_LEARNER_ALIASES = {"serial": "serial",
                          "feature": "feature", "feature_parallel": "feature",
@@ -304,6 +306,32 @@ class Config:
             Log.warning("flight_recorder=true without a telemetry run "
                         "(telemetry_out/metrics_port); no capture can be "
                         "armed")
+        # round-17 online-learning params
+        self.online_update = str(self.online_update).lower()
+        if self.online_update not in ("extend", "refit"):
+            Log.fatal("Unknown online_update %s (expected extend or refit)",
+                      self.online_update)
+        if self.task == "online":
+            if not (int(self.online_min_rows) or float(self.online_interval_s)
+                    or bool(self.online_drift_trigger)
+                    or int(self.online_max_rows_behind)
+                    or float(self.online_max_seconds_behind)):
+                Log.warning("task=online with every retrain trigger off "
+                            "(online_min_rows/online_interval_s/"
+                            "online_drift_trigger/freshness SLOs): the "
+                            "trainer will never fire")
+            if bool(self.online_drift_trigger) \
+                    and not bool(self.quality_monitor):
+                Log.warning("online_drift_trigger=true needs the quality "
+                            "monitor (quality_monitor=true) and a telemetry "
+                            "run; the drift trigger will never fire "
+                            "without them")
+        if int(self.online_window_rows) \
+                and int(self.online_window_rows) > int(self.online_buffer_rows):
+            Log.warning("online_window_rows=%d exceeds online_buffer_rows=%d;"
+                        " windows are capped by the buffer",
+                        int(self.online_window_rows),
+                        int(self.online_buffer_rows))
         if ("io_retry_attempts" in self.raw_params
                 or "io_retry_backoff_s" in self.raw_params):
             # the retry policy guards a process-global primitive

@@ -20,7 +20,7 @@ from .config import alias_transform
 from .utils.log import Log
 from .utils.timer import global_timer
 
-__all__ = ["train", "cv", "serve", "CVBooster"]
+__all__ = ["train", "cv", "serve", "serve_and_train", "CVBooster"]
 
 _NUM_BOOST_ROUND_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
                             "n_iter", "num_tree", "num_trees", "num_round",
@@ -314,6 +314,32 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
 
 
+def _configure_owned_telemetry(cfg, entry: str):
+    """Serving-entry telemetry bootstrap shared by :func:`serve` and
+    :func:`serve_and_train`: when the params ask for a run
+    (``telemetry_out`` and/or ``metrics_port``) and none is active,
+    configure one owned by the caller (its Server finalizes + closes it).
+    Returns the Telemetry or None."""
+    t_out = str(getattr(cfg, "telemetry_out", "") or "")
+    m_port = int(getattr(cfg, "metrics_port", 0))
+    if not (t_out or m_port > 0) or obs.active() is not None:
+        return None
+    # metrics_port without telemetry_out still gets a (memory-sink) run:
+    # the live scrape surface needs a registry to render
+    return obs.configure(out=t_out or None,
+                         freq=int(getattr(cfg, "telemetry_freq", 1)),
+                         metrics_port=m_port,
+                         metrics_addr=str(getattr(cfg, "metrics_addr", "")
+                                          or "127.0.0.1"),
+                         alert_rules=str(getattr(cfg, "alert_rules", "")
+                                         or "") or None,
+                         alert_interval_s=float(
+                             getattr(cfg, "alert_interval_s", 1.0)),
+                         flight_recorder=bool(
+                             getattr(cfg, "flight_recorder", False)),
+                         entry=entry)
+
+
 def serve(models, params: Optional[Dict[str, Any]] = None, **server_kwargs):
     """Start a serving tier (lightgbm_tpu/serving) over one or many models.
 
@@ -331,27 +357,7 @@ def serve(models, params: Optional[Dict[str, Any]] = None, **server_kwargs):
     from .serving import Server
 
     cfg = Config(alias_transform(dict(params or {})))
-    t_out = str(getattr(cfg, "telemetry_out", "") or "")
-    m_port = int(getattr(cfg, "metrics_port", 0))
-    own_tele = None
-    if (t_out or m_port > 0) and obs.active() is None:
-        # metrics_port without telemetry_out still gets a (memory-sink)
-        # run: the live scrape surface needs a registry to render
-        own_tele = obs.configure(out=t_out or None,
-                                 freq=int(getattr(cfg, "telemetry_freq", 1)),
-                                 metrics_port=m_port,
-                                 metrics_addr=str(
-                                     getattr(cfg, "metrics_addr", "")
-                                     or "127.0.0.1"),
-                                 alert_rules=str(
-                                     getattr(cfg, "alert_rules", "")
-                                     or "") or None,
-                                 alert_interval_s=float(
-                                     getattr(cfg, "alert_interval_s", 1.0)),
-                                 flight_recorder=bool(
-                                     getattr(cfg, "flight_recorder",
-                                             False)),
-                                 entry="engine.serve")
+    own_tele = _configure_owned_telemetry(cfg, "engine.serve")
     server = None
     try:
         # the run stays open for telemetry_summary() reads while serving;
@@ -378,6 +384,71 @@ def serve(models, params: Optional[Dict[str, Any]] = None, **server_kwargs):
             obs.disable()
         raise
     return server
+
+
+def serve_and_train(booster, train_set=None,
+                    params: Optional[Dict[str, Any]] = None,
+                    name: str = "model",
+                    checkpoint_prefix: Optional[str] = None,
+                    publish_out: Optional[str] = None,
+                    warm=True, **server_kwargs):
+    """Start the train-while-serve loop (lightgbm_tpu/online): one process
+    that serves ``booster`` through the round-13 tier while a trainer
+    thread ingests fresh labeled rows (``controller.ingest(X, y)``) and
+    republishes each continued generation through ``ModelRegistry.swap``.
+
+    ``booster`` is a Booster / GBDT / model-file path; ``train_set`` the
+    base :class:`~lightgbm_tpu.io.dataset.BinnedDataset` (or
+    :class:`Dataset`) whose bin layout every ingested window is binned
+    against (defaults to the booster's attached training data).
+    ``params`` feeds both the serving knobs and the ``online_*`` policy
+    params (cadence ``online_min_rows``/``online_interval_s``, the drift
+    trigger, the freshness SLO, ``online_rounds``/``online_update``);
+    ``checkpoint_prefix`` arms the steady-state checkpoint path (cycle
+    windows + snapshot/emergency checkpoints land under it, and a rerun
+    resumes the preempted cycle), ``publish_out`` persists each published
+    generation's model text so a restarted process warm-starts from the
+    newest one.  Extra keyword arguments go to
+    :class:`~lightgbm_tpu.serving.Server`.
+
+    Returns the running
+    :class:`~lightgbm_tpu.online.OnlineController` — submit with
+    ``controller.submit(rows)``, feed with ``controller.ingest(X, y)``,
+    and ``controller.close()`` when done (also a context manager)."""
+    from .config import Config
+    from .online import OnlineController
+    from .serving import Server
+
+    cfg = Config(alias_transform(dict(params or {})))
+    own_tele = _configure_owned_telemetry(cfg, "engine.serve_and_train")
+    server = None
+    try:
+        server = Server(config=cfg, owned_telemetry=own_tele,
+                        **server_kwargs)
+        if isinstance(booster, str):
+            from .boosting.gbdt import GBDT
+            booster = GBDT.load_model(booster, cfg)
+        if train_set is not None:
+            construct = getattr(train_set, "construct", None)
+            if construct is not None:
+                train_set = construct()
+            train_set = getattr(train_set, "handle", train_set)
+        controller = OnlineController(
+            server=server, name=name, booster=booster, base_ds=train_set,
+            config=cfg, checkpoint_prefix=checkpoint_prefix,
+            publish_out=publish_out, warm=warm)
+        controller.start()
+    except BaseException:
+        # a failed construction must not leak the dispatcher thread or
+        # hold the process-active telemetry slot hostage (same unwind as
+        # engine.serve)
+        if server is not None:
+            server.disown_telemetry()
+            server.close(drain=False)
+        if own_tele is not None and obs.active() is own_tele:
+            obs.disable()
+        raise
+    return controller
 
 
 class CVBooster:

@@ -200,6 +200,16 @@ def render_prometheus(snapshot: Dict[str, Any],
                ['%s{model="%s"} %s'
                 % (beh, lbl(m), _prom_val(info.get("seconds_behind")))
                 for m, info in sorted(models.items())])
+        # rows-behind freshness (the online loop's ingested-vs-trained
+        # counters); rendered only for models that report it so a plain
+        # serving run never exposes a NaN series
+        rb_samples = ['%s{model="%s"} %s'
+                      % (_PREFIX + "model_rows_behind", lbl(m),
+                         _prom_val(info.get("rows_behind")))
+                      for m, info in sorted(models.items())
+                      if info.get("rows_behind") is not None]
+        if rb_samples:
+            metric(_PREFIX + "model_rows_behind", "gauge", rb_samples)
         qr = _PREFIX + "quality_rows_observed"
         metric(qr, "gauge",
                ['%s{model="%s"} %s' % (qr, lbl(m),

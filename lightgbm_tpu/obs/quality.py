@@ -461,11 +461,32 @@ class QualityMonitor:
                         trained_at: Optional[float] = None,
                         published_at: Optional[float] = None) -> None:
         with self._lock:
+            prev = self._provenance.get(str(name)) or {}
             self._provenance[str(name)] = {
                 "generation": int(generation),
                 "trained_at": trained_at,
                 "published_at": published_at,
+                # the online buffer's freshness counters survive the
+                # republish (note_freshness refreshes them right after)
+                **{k: prev[k] for k in ("rows_behind", "rows_ingested",
+                                        "rows_trained") if k in prev},
             }
+
+    def note_freshness(self, name: str,
+                       rows_behind: Optional[int] = None,
+                       rows_ingested: Optional[int] = None,
+                       rows_trained: Optional[int] = None) -> None:
+        """The online loop's ingested-vs-trained row counters: the
+        ``rows_behind`` gauge next to ``seconds_behind`` — how many
+        labeled rows arrived since the live generation trained."""
+        with self._lock:
+            prov = self._provenance.setdefault(str(name), {})
+            if rows_behind is not None:
+                prov["rows_behind"] = int(rows_behind)
+            if rows_ingested is not None:
+                prov["rows_ingested"] = int(rows_ingested)
+            if rows_trained is not None:
+                prov["rows_trained"] = int(rows_trained)
 
     # -- accumulation --
 
@@ -529,6 +550,7 @@ class QualityMonitor:
                        psi_max=entry.get("psi_max"),
                        feature_max=entry.get("feature_max"),
                        level=entry.get("level"),
+                       rows_behind=entry.get("rows_behind"),
                        top=json.dumps(entry.get("features", []),
                                       separators=(",", ":")))
 
@@ -584,6 +606,7 @@ class QualityMonitor:
             "trained_at": trained_at,
             "seconds_behind": (round(now - behind, 3)
                                if behind is not None else None),
+            "rows_behind": prov.get("rows_behind"),
             "overhead_ns_per_row": (round(st.ns_spent / st.rows, 1)
                                     if st.rows else None),
             "features": feats[:k],
@@ -618,6 +641,7 @@ class QualityMonitor:
                         "seconds_behind": (round(now - behind, 3)
                                            if behind is not None
                                            else None),
+                        "rows_behind": prov.get("rows_behind"),
                         "overhead_ns_per_row": None, "features": [],
                     }
                 else:

@@ -97,6 +97,29 @@ def serving_block(counters: Dict[str, Any], gauges: Dict[str, Any],
     }
 
 
+_ONLINE_TRIG = "online_trigger_"
+
+
+def online_block(counters: Dict[str, Any], gauges: Dict[str, Any],
+                 hists: Dict[str, Any]):
+    """Fold the online controller's metrics into one summary section
+    (None when the run never trained while serving).  Shared by
+    :func:`summarize` and ``tools/obs_report.py``'s died-run recovery."""
+    cycles = counters.get("online_cycles")
+    if not cycles:
+        return None
+    return {
+        "cycles": int(cycles),
+        "generation": gauges.get("online_generation"),
+        "rows_behind": gauges.get("online_rows_behind"),
+        "triggers": {name[len(_ONLINE_TRIG):]: int(n)
+                     for name, n in sorted(counters.items())
+                     if name.startswith(_ONLINE_TRIG)},
+        "train_s": hists.get("online_train_s", {"count": 0}),
+        "publish_s": hists.get("online_publish_s", {"count": 0}),
+    }
+
+
 def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
               ) -> Dict[str, Any]:
     """Fold a run's registry + recompile counters into the summary dict."""
@@ -190,6 +213,12 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
     serving = serving_block(counters, gauges, hists)
     if serving is not None:
         out["serving"] = serving
+    # online-learning rollup (lightgbm_tpu/online): train-while-serve
+    # cycles by trigger, the live generation and the rows-behind gauge —
+    # present only when the run ran a controller
+    online = online_block(counters, gauges, hists)
+    if online is not None:
+        out["online"] = online
     # performance-forensics rollups (round 16), each present only when its
     # run-owned state exists: compile wall-seconds per (fn, bucket) — the
     # autotuner's ranking substrate — device-memory high-water, profiler
@@ -290,7 +319,7 @@ def human_table(summary: Dict[str, Any]) -> str:
         for name, info in sorted(qual["models"].items()):
             row("    model %s" % name,
                 "gen=%s rows=%d level=%s psi_max=%s@%s score_psi=%s "
-                "behind=%ss"
+                "behind=%ss/%srows"
                 % (info.get("generation"), info.get("rows", 0),
                    info.get("level", "ok"),
                    "-" if info.get("psi_max") is None
@@ -299,12 +328,30 @@ def human_table(summary: Dict[str, Any]) -> str:
                    "-" if info.get("score_psi") is None
                    else "%.4f" % info["score_psi"],
                    "-" if info.get("seconds_behind") is None
-                   else "%.0f" % info["seconds_behind"]))
+                   else "%.0f" % info["seconds_behind"],
+                   "-" if info.get("rows_behind") is None
+                   else "%d" % info["rows_behind"]))
             for f in (info.get("features") or [])[:5]:
                 row("      %s" % f.get("name"),
                     "psi=%.4f js=%.4f imp=%.4f"
                     % (f.get("psi", 0.0), f.get("js", 0.0),
                        f.get("importance", 0.0)))
+    onl = summary.get("online") or {}
+    if onl:
+        lines.append("  online:")
+        trig = onl.get("triggers") or {}
+        row("    cycles", "%d (%s) gen=%s rows_behind=%s"
+            % (onl.get("cycles", 0),
+               ", ".join("%s=%d" % kv for kv in sorted(trig.items()))
+               or "-",
+               onl.get("generation"),
+               onl.get("rows_behind")))
+        for key in ("train_s", "publish_s"):
+            h = onl.get(key) or {}
+            if h.get("count"):
+                row("    " + key, "n=%d p50=%.6g p99=%.6g"
+                    % (h["count"], h.get("p50", float("nan")),
+                       h.get("p99", float("nan"))))
     comp = summary.get("compile") or {}
     if comp.get("keys"):
         lines.append("  compile:")

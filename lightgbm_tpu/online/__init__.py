@@ -1,0 +1,40 @@
+"""Online learning: one process that trains while it serves.
+
+The serving tier (lightgbm_tpu/serving) publishes models; the training
+runtime (boosting + checkpoint + resilience) produces them; the quality
+plane (obs/quality.py) says when the live one has rotted.  This package
+composes the three into a continual-learning loop:
+
+- :class:`~.buffer.RowBuffer` — a bounded host-side buffer of fresh
+  labeled rows (``ingest`` from the request path or a feed), with the
+  ingested-vs-trained counters that back the ``rows_behind`` freshness
+  gauge;
+- :class:`~.policy.RetrainPolicy` — when to cut the next generation:
+  cadence (every N rows / T seconds), drift (the quality plane's
+  per-model ``level == "alert"`` hook, exactly as documented in round
+  15), and a freshness SLO (``rows_behind`` / ``seconds_behind`` caps);
+- :class:`~.controller.OnlineController` — the long-lived process glue:
+  a trainer loop that bins each window of fresh rows against the live
+  bin layout (``BinnedDataset.from_matrix(reference=base)``), extends
+  the ensemble incrementally through the warm-start continuation
+  contract (``GBDT.warm_start_continuation``: absolute-iteration
+  bagging/chunk clocks, so a continued run is byte-identical to
+  checkpoint-resume at the same boundary) or ``refit``s its leaf values,
+  and republishes each generation through ``ModelRegistry.swap`` — zero
+  dropped requests, zero steady-state recompiles outside swap warmup.
+
+The checkpoint runtime is the loop's STEADY-STATE mechanism, not its
+disaster path: every cycle persists its training window
+(``<prefix>.online_window.npz``) before the first chunk and rides the
+ordinary ``snapshot_freq``/preemption checkpoints, so a SIGTERM mid-cycle
+exits ``EXIT_PREEMPTED`` (75) and the rerun rebins the saved window,
+restores bit-exactly, and publishes the SAME next generation.
+
+Entry points: ``lightgbm_tpu.serve_and_train(...)`` (engine), CLI
+``task=online``.
+"""
+from .buffer import RowBuffer
+from .controller import OnlineController
+from .policy import RetrainPolicy
+
+__all__ = ["RowBuffer", "RetrainPolicy", "OnlineController"]

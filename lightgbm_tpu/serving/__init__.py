@@ -21,7 +21,9 @@ users into those cached bucket dispatches:
   telemetry summary and driven by ``tools/bench_serve.py``.
 
 Entry points: ``lightgbm_tpu.serve(...)`` (engine), ``Booster.serve()``,
-CLI ``task=serve``.
+CLI ``task=serve``; ``lightgbm_tpu.serve_and_train(...)`` / ``task=online``
+wrap a Server in the round-17 train-while-serve loop
+(``lightgbm_tpu/online``).
 """
 from .registry import ModelRegistry, ResidentModel
 from .scheduler import Server, ServingClosed, ServingQueueFull

@@ -1,0 +1,622 @@
+"""Online learning subsystem (lightgbm_tpu/online): train-while-serve.
+
+Pins the round-17 invariants:
+
+- RowBuffer bounded-buffer + ingested/trained/dropped accounting;
+- RetrainPolicy trigger precedence incl. the quality plane's
+  ``level == "alert"`` hook;
+- the warm-start continuation contract: ``train(k)`` -> publish ->
+  continue-to-``k+m`` is BYTE-identical to the checkpoint-resume path at
+  the same boundary (bagging on — absolute-iteration clocks);
+- the long-run acceptance loop: fixed-qps traffic while the trainer
+  publishes >= 3 generations (>= 1 drift-triggered), 0 dropped requests,
+  0 steady-state recompiles outside swap warmup, every response
+  bit-exact vs the generation that served it, and
+  ``seconds_behind``/``rows_behind`` reset on each publish;
+- refit-mode republish as a pure jit-cache hit (0 recompiles incl. the
+  swap);
+- rows_behind surfacing: /metrics gauge, summary quality + online
+  blocks, and ``tools/obs_report.py`` died-run recovery.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import obs, serve_and_train
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+from lightgbm_tpu.online import OnlineController, RetrainPolicy, RowBuffer
+from lightgbm_tpu.online.controller import WINDOW_SUFFIX
+from lightgbm_tpu.utils.log import LightGBMError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_xy(seed, n=400, shift=None):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2, 2, size=(n, 5))
+    if shift is not None:
+        X[:, 0] = rng.uniform(*shift, size=n)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2)
+         + 0.1 * rng.normal(size=n)).astype(np.float64)
+    return X, y
+
+
+def _bootstrap(seed=0, n=400, rounds=4, **params):
+    X, y = _make_xy(seed, n)
+    cfg = Config(objective="regression", num_leaves=8, min_data_in_leaf=5,
+                 verbosity=-1, num_iterations=rounds, max_bin=63, **params)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63,
+                                   min_data_in_leaf=5)
+    b = create_boosting(cfg.boosting, cfg, ds,
+                        create_objective(cfg.objective, cfg))
+    b.train()
+    return b, ds, X, y
+
+
+def _params(**over):
+    p = {"objective": "regression", "verbosity": -1,
+         "online_rounds": 2, "online_min_rows": 0, "online_interval_s": 0,
+         "online_drift_trigger": False, "online_poll_s": 0.02,
+         "max_batch_wait_us": 0}
+    p.update(over)
+    return p
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_run():
+    yield
+    obs.disable()
+
+
+# ---- RowBuffer ----
+
+def test_row_buffer_accounting():
+    buf = RowBuffer(width=3, max_rows=100)
+    assert buf.ingest(np.zeros((10, 3)), np.zeros(10)) == 10
+    assert buf.rows_behind() == 10 and buf.buffered == 10
+    X, y, w, taken = buf.window()
+    assert len(X) == 10 and w is None and taken == 10
+    buf.mark_trained(taken)
+    assert buf.rows_behind() == 0
+    # consumed rows remain buffered (sliding history) but are not behind
+    assert buf.buffered == 10
+    buf.ingest(np.ones((4, 3)), np.ones(4), weight=np.full(4, 2.0))
+    X, y, w, taken = buf.window(max_rows=6)
+    assert len(X) == 6 and taken == 4
+    # weights fill with ones for weightless chunks
+    assert w is not None and w[-1] == 2.0 and w[0] == 1.0
+
+
+def test_row_buffer_bounded_drop_oldest():
+    buf = RowBuffer(width=2, max_rows=10)
+    buf.ingest(np.full((6, 2), 1.0), np.zeros(6))
+    buf.ingest(np.full((6, 2), 2.0), np.zeros(6))
+    # first chunk evicted: buffered stays bounded, dropped counted, and
+    # rows_behind reflects only what can still be trained
+    assert buf.buffered == 6
+    assert buf.rows_dropped == 6
+    assert buf.rows_behind() == 6
+    X, _, _, taken = buf.window()
+    assert np.all(X == 2.0) and taken == 6
+
+
+def test_row_buffer_validation():
+    buf = RowBuffer(width=3, max_rows=10)
+    with pytest.raises(LightGBMError):
+        buf.ingest(np.zeros((2, 4)), np.zeros(2))
+    with pytest.raises(LightGBMError):
+        buf.ingest(np.zeros((2, 3)), np.zeros(3))
+
+
+# ---- RetrainPolicy ----
+
+def test_policy_triggers_and_precedence():
+    now = 1000.0
+    p = RetrainPolicy(min_rows=100, interval_s=50.0, drift_trigger=True,
+                      max_rows_behind=500, max_seconds_behind=200.0)
+    # no fresh rows -> never fire, whatever else is true
+    assert p.reason(0, 0.0, {"level": "alert", "rows": 9999},
+                    now=now) is None
+    alert = {"level": "alert", "rows": 1000}
+    assert p.reason(1, now, alert, now=now) == "drift"
+    # below the drift row floor the alert is noise
+    assert p.reason(1, now, {"level": "alert", "rows": 10}, now=now) is None
+    assert p.reason(600, now, None, now=now) == "freshness_rows"
+    assert p.reason(1, now - 300, None, now=now) == "freshness_seconds"
+    assert p.reason(150, now, None, now=now) == "rows"
+    assert p.reason(1, now - 60, None, now=now) == "interval"
+    assert p.reason(1, now, None, now=now) is None
+    off = RetrainPolicy(min_rows=0, interval_s=0, drift_trigger=False)
+    assert not off.active()
+    assert RetrainPolicy(min_rows=1).active()
+
+
+# ---- warm-start continuation contract ----
+
+def test_warm_start_equivalence_checkpoint_resume(tmp_path):
+    """train(k) -> publish -> continue-to-k+m is byte-identical to the
+    checkpoint-resume path at the same boundary, with bagging ON: the
+    continuation clock is absolute, so the stateless bagging hash and
+    the config-keyed chunk partitioning reproduce the uninterrupted
+    stream."""
+    k, m = 4, 4
+
+    def build(n_iter):
+        return _bootstrap(seed=0, rounds=n_iter, bagging_fraction=0.8,
+                          bagging_freq=1, snapshot_freq=2)
+
+    # uninterrupted reference (bootstraps straight to k+m)
+    ref, _, _, _ = build(k + m)
+    ref_str = ref.save_model_to_string()
+
+    # checkpoint-resume path: checkpoint at k, restore, finish
+    a, _, _, _ = build(k)
+    prefix = str(tmp_path / "ck")
+    a.save_checkpoint(prefix)
+    b2, ds2, _, _ = _fresh_untouched(k + m)
+    assert b2.resume_from_checkpoint(prefix) == k
+    b2.train()
+    resume_str = b2.save_model_to_string()
+    assert resume_str == ref_str
+
+    # online warm-start path: publish the k-round model text, continue in
+    # a FRESH booster through warm_start_continuation
+    pub, _, _, _ = build(k)
+    model_str = pub.save_model_to_string()
+    c, ds_c, _, _ = _fresh_untouched(k + m)
+    assert c.warm_start_continuation(model_str, train_data=ds_c,
+                                     objective=c.objective) == k
+    c.train()
+    assert c.save_model_to_string() == ref_str == resume_str
+
+
+def _fresh_untouched(n_iter):
+    """A booster configured for n_iter total iterations but with NONE
+    trained yet (the _bootstrap helper trains eagerly)."""
+    X, y = _make_xy(0, 400)
+    cfg = Config(objective="regression", num_leaves=8, min_data_in_leaf=5,
+                 verbosity=-1, num_iterations=n_iter, max_bin=63,
+                 bagging_fraction=0.8, bagging_freq=1, snapshot_freq=2)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63,
+                                   min_data_in_leaf=5)
+    b = create_boosting(cfg.boosting, cfg, ds,
+                        create_objective(cfg.objective, cfg))
+    return b, ds, X, y
+
+
+# ---- window dataset ----
+
+def test_window_dataset_clones_mappers_with_window_occupancy():
+    b, ds, X, y = _bootstrap()
+    ctrl = OnlineController.__new__(OnlineController)
+    ctrl.base_ds = ds
+    Xw, yw = _make_xy(3, 120)
+    wds = OnlineController._window_dataset(ctrl, Xw, yw, None)
+    assert wds.num_data == 120
+    # shared layout: same bounds/EFB grouping, so routing is identical
+    assert wds.group_idx is ds.group_idx
+    for i, (m_base, m_win) in enumerate(zip(ds.bin_mappers,
+                                            wds.bin_mappers)):
+        assert m_win is not m_base
+        if not m_base.is_trivial:
+            assert m_win.num_bin == m_base.num_bin
+            np.testing.assert_array_equal(m_win.bin_upper_bound,
+                                          m_base.bin_upper_bound)
+            # window occupancy, not the base training occupancy
+            want = np.bincount(m_base.values_to_bins(Xw[:, i]),
+                               minlength=m_base.num_bin)
+            np.testing.assert_array_equal(m_win.cnt_in_bin, want)
+            assert int(m_win.cnt_in_bin.sum()) == 120
+    # the base mappers were never mutated
+    assert all(m.cnt_in_bin is None or int(m.cnt_in_bin.sum()) != 120
+               for m in ds.bin_mappers if not m.is_trivial)
+
+
+# ---- controller basics ----
+
+def test_controller_extend_cycle_and_stats(tmp_path):
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m")
+    try:
+        assert ctrl.generation == 1
+        it0 = ctrl.booster.iter_
+        Xf, yf = _make_xy(5, 150)
+        ctrl.ingest(Xf, yf)
+        assert ctrl.run_cycle("manual")
+        st = ctrl.stats()
+        assert st["generation"] == 2 and st["cycles"] == 1
+        assert st["iterations"] == it0 + 2  # online_rounds=2, extend
+        assert st["rows_behind"] == 0
+        assert st["rows_ingested"] == 150 and st["rows_trained"] == 150
+        # the published generation is frozen: further trainer mutation
+        # must not change what serves
+        ref = ctrl.predict(X[:8].astype(np.float32))
+        Xf2, yf2 = _make_xy(6, 150)
+        ctrl.ingest(Xf2, yf2)
+        assert ctrl.run_cycle("manual")
+        assert ctrl.generation == 3
+        got = ctrl.predict(X[:8].astype(np.float32))
+        assert not np.array_equal(ref, got)  # new generation serves
+    finally:
+        ctrl.close()
+    assert ctrl.stats()["serving"]["dropped"] == 0
+
+
+def test_online_update_param_validated():
+    with pytest.raises(LightGBMError):
+        Config(online_update="nope")
+
+
+def test_empty_window_cycle_is_noop():
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m")
+    try:
+        assert not ctrl.run_cycle("manual")          # nothing buffered
+        ctrl.ingest(*_make_xy(5, 50))
+        assert ctrl.run_cycle("manual")
+        # fresh-rows guard: the auto-trigger path cannot double-fire on
+        # the unchanged window
+        assert not ctrl.run_cycle("flush", require_fresh=True)
+        assert ctrl.generation == 2
+    finally:
+        ctrl.close()
+
+
+def test_ingest_width_validation():
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m")
+    try:
+        with pytest.raises(LightGBMError):
+            ctrl.ingest(np.zeros((3, 2)), np.zeros(3))
+    finally:
+        ctrl.close()
+
+
+def test_refit_mode_republish_pure_cache_hit():
+    """online_update=refit keeps the ensemble shapes constant, so the
+    whole cycle — window binning aside, after one warmup cycle — and the
+    republish are recompile-free."""
+    from lightgbm_tpu.obs import recompile
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(
+        b, train_set=ds,
+        params=_params(online_update="refit", online_window_rows=128),
+        name="m")
+    try:
+        ref = ctrl.predict(X[:8].astype(np.float32))
+        # warmup cycle compiles the refit-path programs once
+        ctrl.ingest(*_make_xy(5, 128))
+        assert ctrl.run_cycle("warmup")
+        ctrl.predict(X[:8].astype(np.float32))
+        base = recompile.total()
+        ctrl.ingest(*_make_xy(6, 128))
+        assert ctrl.run_cycle("steady")
+        got = ctrl.predict(X[:8].astype(np.float32))
+        assert recompile.total() - base == 0, \
+            "refit republish recompiled"
+        assert ctrl.generation == 3
+        assert ctrl.booster.num_trees == b.num_trees  # structure frozen
+        assert not np.array_equal(ref, got)  # values did move
+    finally:
+        ctrl.close()
+
+
+# ---- window persistence / resume plumbing ----
+
+def test_window_persist_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model.txt")
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m",
+                           checkpoint_prefix=prefix, publish_out=prefix)
+    try:
+        Xf, yf = _make_xy(5, 60)
+        meta = {"cycle": 1, "reason": "t", "taken": 60, "mode": "extend",
+                "target_iterations": 6, "rows_ingested": 60,
+                "rows_trained": 0, "rows_dropped": 0}
+        ctrl._persist_window(Xf, yf, None, meta)
+        path = prefix + WINDOW_SUFFIX
+        assert os.path.exists(path)
+        pending = ctrl._load_pending_window()
+        assert pending is not None
+        np.testing.assert_array_equal(pending["X"], Xf)
+        np.testing.assert_array_equal(pending["y"], yf)
+        assert pending["w"] is None
+        assert pending["meta"] == meta
+        # a cycle consumes the file
+        ctrl.ingest(Xf, yf)
+        assert ctrl.run_cycle("manual")
+        assert not os.path.exists(path)
+        # every publish persisted the generation model text
+        assert os.path.exists(prefix)
+    finally:
+        ctrl.close()
+
+
+def test_publish_out_warm_start(tmp_path):
+    """A restarted process warm-starts from the newest published
+    generation (never from scratch): the rebuilt controller's trainer
+    starts at the published iteration count and generation 1 serves the
+    published model's scores."""
+    prefix = str(tmp_path / "model.txt")
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m",
+                           publish_out=prefix)
+    ctrl.ingest(*_make_xy(5, 100))
+    assert ctrl.run_cycle("manual")
+    want = ctrl.predict(X[:8].astype(np.float32))
+    iters = ctrl.booster.iter_
+    ctrl.close()
+
+    b2, ds2, _, _ = _bootstrap()   # the same bootstrap a rerun would do
+    ctrl2 = serve_and_train(b2, train_set=ds2, params=_params(), name="m",
+                            publish_out=prefix)
+    try:
+        assert ctrl2.booster.iter_ == iters
+        got = ctrl2.predict(X[:8].astype(np.float32))
+        np.testing.assert_array_equal(want, got)
+    finally:
+        ctrl2.close()
+
+
+# ---- drift-triggered refit, end to end ----
+
+def test_drift_triggered_cycle_comes_back_clean(tmp_path):
+    """Shifted traffic -> quality alert -> the policy fires with
+    trigger="drift" -> the new generation (trained on the shifted
+    window) scores the same traffic as quiet."""
+    tele = obs.configure(out=str(tmp_path / "drift.jsonl"), freq=1)
+    b, ds, X, y = _bootstrap(n=600)
+    ctrl = serve_and_train(
+        b, train_set=ds,
+        params=_params(online_drift_trigger=True, online_poll_s=0.02,
+                       online_rounds=2),
+        name="m")
+    try:
+        # shifted feature-0 traffic, served AND (labels known) ingested
+        Xs, ys = _make_xy(21, 600, shift=(5.0, 9.0))
+        for lo in range(0, 600, 100):
+            ctrl.predict(Xs[lo:lo + 100].astype(np.float32))
+        ctrl.ingest(Xs, ys)
+        from lightgbm_tpu.obs import quality as _quality
+        mon = _quality.monitor(tele)
+        snap = mon.snapshot()["models"]["m"]
+        assert snap["level"] == "alert", snap
+        deadline = time.time() + 60
+        while ctrl.generation < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert ctrl.generation >= 2, (ctrl.stats(), ctrl.last_error)
+        assert ctrl.last_trigger == "drift"
+        # the new generation's baseline is its own (shifted) training
+        # window: the same traffic now reads clean
+        for lo in range(0, 600, 100):
+            ctrl.predict(Xs[lo:lo + 100].astype(np.float32))
+        snap2 = mon.snapshot()["models"]["m"]
+        assert snap2["generation"] >= 2
+        assert snap2["level"] == "ok", snap2
+    finally:
+        ctrl.close()
+        obs.disable()
+
+
+# ---- the long-run acceptance loop ----
+
+def test_long_run_acceptance(tmp_path):
+    """One process serves fixed-qps traffic while the trainer publishes
+    >= 3 generations (>= 1 drift-triggered): 0 dropped requests, every
+    response bit-exact vs the generation that served it, 0 steady-state
+    recompiles outside swap warmup, and seconds_behind/rows_behind reset
+    on each publish."""
+    from lightgbm_tpu.obs import recompile
+    tele = obs.configure(out=str(tmp_path / "long.jsonl"), freq=1)
+    b, ds, X, y = _bootstrap(n=600)
+    # warm every rung the open-loop traffic can coalesce into (1/17/64-row
+    # requests merge past 128 under backlog): publishes pre-compile both,
+    # so the steady windows between swaps stay recompile-free
+    ctrl = serve_and_train(
+        b, train_set=ds,
+        params=_params(online_min_rows=150, online_drift_trigger=True,
+                       online_poll_s=0.02, online_rounds=2),
+        name="m", warm=(128, 1024))
+    pool = X[:64].astype(np.float32)
+    sizes = (1, 17, 64)
+    responses = []
+    refs = []
+
+    def capture_refs():
+        refs.append({n: ctrl.predict(pool[:n], raw_score=True)
+                     for n in sizes})
+
+    def paced_traffic(n_req, qps=120.0, rows=None):
+        """Open-loop fixed-qps submits; responses validated at the end."""
+        interval = 1.0 / qps
+        t0 = time.perf_counter()
+        futs = []
+        rng = np.random.RandomState(len(responses))
+        for i in range(n_req):
+            target = t0 + i * interval
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            n = int(sizes[rng.randint(len(sizes))])
+            src = pool if rows is None else rows
+            futs.append((n, ctrl.submit(src[:n], raw_score=True)))
+        for n, f in futs:
+            responses.append((n, f.result(timeout=120)))
+
+    def wait_generation(g, timeout=90.0):
+        deadline = time.time() + timeout
+        while ctrl.generation < g and time.time() < deadline:
+            if ctrl.cycle_failures:
+                raise AssertionError(ctrl.last_error)
+            time.sleep(0.02)
+        assert ctrl.generation >= g, ctrl.stats()
+        capture_refs()
+
+    try:
+        capture_refs()
+        # two cadence-triggered generations under paced traffic
+        for phase in (31, 32):
+            Xf, yf = _make_xy(phase, 160)
+            ctrl.ingest(Xf, yf)
+            paced_traffic(60)
+            wait_generation(len(refs) + 1)
+        # one drift-triggered generation: shifted traffic observed by the
+        # quality plane, a small (below-cadence) labeled batch ingested
+        Xs, ys = _make_xy(33, 600, shift=(5.0, 9.0))
+        for lo in range(0, 600, 100):
+            ctrl.predict(Xs[lo:lo + 100].astype(np.float32))
+        ctrl.ingest(Xs[:100], ys[:100])
+        paced_traffic(40)
+        wait_generation(4)
+        assert ctrl.cycles >= 3
+        assert "drift" in [ctrl.last_trigger] \
+            or tele.registry.snapshot()["counters"].get(
+                "online_trigger_drift"), ctrl.stats()
+
+        # freshness resets on publish: the quality snapshot's rows_behind
+        # reads 0 and seconds_behind is fresh
+        from lightgbm_tpu.obs import quality as _quality
+        snap = _quality.monitor(tele).snapshot()["models"]["m"]
+        assert snap["rows_behind"] == 0, snap
+        assert snap["seconds_behind"] is not None \
+            and snap["seconds_behind"] < 60, snap
+        assert snap["generation"] == ctrl.generation
+
+        # steady state outside swap warmup: a post-publish serving window
+        # compiles nothing (gauge-pinned)
+        for n in sizes:
+            ctrl.predict(pool[:n], raw_score=True)
+        base = recompile.total()
+        paced_traffic(40)
+        assert recompile.total() - base == 0, recompile.counts()
+
+        # every accepted response is bit-exact vs ONE published
+        # generation's reference scores
+        bad = sum(1 for n, got in responses
+                  if not any(np.array_equal(got, r[n]) for r in refs))
+        assert bad == 0, "%d/%d responses matched no generation" \
+            % (bad, len(responses))
+        assert len(responses) == 200  # 60 + 60 + 40 paced + 40 steady
+        st = ctrl.stats()
+        assert st["serving"]["dropped"] == 0
+        assert st["serving"]["registry"]["swaps"] >= 3
+    finally:
+        ctrl.close()
+        obs.disable()
+
+
+# ---- observability surfacing ----
+
+def test_rows_behind_gauge_summary_and_recovery(tmp_path):
+    jsonl = str(tmp_path / "onl.jsonl")
+    tele = obs.configure(out=jsonl, freq=1)
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m")
+    try:
+        ctrl.ingest(*_make_xy(5, 120))
+        assert ctrl.run_cycle("manual")
+        ctrl.ingest(*_make_xy(6, 30))   # 30 rows now behind
+        # serve some traffic so the monitor folds rows + emits drift
+        # breadcrumbs (which carry rows_behind for died-run recovery)
+        for _ in range(3):
+            ctrl.predict(X[:32].astype(np.float32))
+
+        from lightgbm_tpu.obs.exporter import render_prometheus
+        from lightgbm_tpu.obs import quality as _quality
+        mon = _quality.monitor(tele)
+        snap = mon.snapshot()
+        assert snap["models"]["m"]["rows_behind"] == 30
+        prom = render_prometheus(tele.registry.snapshot(), quality=snap)
+        assert 'lgbm_tpu_model_rows_behind{model="m"} 30.0' in prom, prom
+        assert 'lgbm_tpu_model_seconds_behind{model="m"}' in prom
+
+        from lightgbm_tpu.obs.report import summarize
+        summary = summarize(tele)
+        assert summary["quality"]["models"]["m"]["rows_behind"] == 30
+        onl = summary["online"]
+        assert onl["cycles"] == 1 and onl["generation"] == 2
+        assert onl["triggers"] == {"manual": 1}
+        assert onl["train_s"]["count"] == 1
+    finally:
+        ctrl.close()
+        obs.disable()
+
+    # died-run recovery: the raw events alone rebuild rows_behind and the
+    # online block
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from obs_report import summary_from_events
+    rec = summary_from_events(obs.iter_events(jsonl))
+    assert rec["online"]["cycles"] == 1
+    assert rec["online"]["triggers"] == {"manual": 1}
+    assert rec["quality"]["models"]["m"].get("rows_behind") == 30
+    from lightgbm_tpu.obs.report import human_table
+    table = human_table(rec)
+    assert "online:" in table and "rows_behind" in table
+
+
+def test_healthz_online_block():
+    from lightgbm_tpu.obs.exporter import health_snapshot
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m")
+    try:
+        health = health_snapshot()
+        onl = health.get("online")
+        assert onl is not None, sorted(health)
+        assert onl["trainer_alive"] is True
+        assert onl["generation"] == 1 and onl["state"] in ("idle",
+                                                           "training",
+                                                           "publishing")
+        assert onl["rows_behind"] == 0
+    finally:
+        ctrl.close()
+    assert "online" not in health_snapshot()
+
+
+def test_online_events_and_spans(tmp_path):
+    jsonl = str(tmp_path / "spans.jsonl")
+    obs.configure(out=jsonl, freq=1)
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m")
+    try:
+        ctrl.ingest(*_make_xy(5, 80))
+        assert ctrl.run_cycle("manual")
+    finally:
+        ctrl.close()
+        obs.disable()
+    evs = obs.read_events(jsonl)
+    cyc = [e for e in evs if e["kind"] == "online_cycle"]
+    assert len(cyc) == 1
+    e = cyc[0]
+    assert e["trigger"] == "manual" and e["generation"] == 2 \
+        and e["rows"] == 80 and e["rows_behind"] == 0
+    spans = {e.get("name") for e in evs if e["kind"] == "span"}
+    # trainer lifecycle spans: the cycle with its train/publish children
+    assert {"online_cycle", "online_train", "online_publish"} <= spans
+
+
+def test_no_telemetry_run_makes_no_quality_state():
+    assert obs.active() is None
+    b, ds, X, y = _bootstrap()
+    ctrl = serve_and_train(b, train_set=ds, params=_params(), name="m")
+    try:
+        ctrl.ingest(*_make_xy(5, 60))
+        assert ctrl.run_cycle("manual")
+        ctrl.predict(X[:8].astype(np.float32))
+        assert obs.active() is None  # nothing configured a run behind us
+    finally:
+        ctrl.close()
+
+
+def test_task_alias_and_engine_export():
+    import lightgbm_tpu as lgb
+    assert lgb.serve_and_train is serve_and_train
+    cfg = Config(task="online")
+    assert cfg.task == "online"
+    cfg = Config(task="serve_and_train")
+    assert cfg.task == "online"

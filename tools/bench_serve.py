@@ -21,6 +21,13 @@ Usage::
     python tools/bench_serve.py --qps 200,1000 --request-rows 1,8,64 \
         --seconds 2 --out BENCH_serve.json [--models 2] [--swap-mid-run]
         [--single-row-fast] [--telemetry-out serve.jsonl]
+        [--online [--online-update refit|extend] [--online-rounds N]]
+
+``--online`` co-runs the train-while-serve controller
+(lightgbm_tpu/online): a feeder ingests labeled rows mid-window so >= 1
+retrain cycle + hot-swap lands inside every timed cell — the artifact's
+headline becomes p99-under-retrain (gated by ``serve_p99_online_factor``
+vs the serve baseline) and carries an ``online`` block.
 """
 import argparse
 import json
@@ -57,6 +64,18 @@ def parse_args(argv=None):
     ap.add_argument("--single-row-fast", action="store_true",
                     help="serve batch-size-1 requests through the compiled "
                          "single-row path")
+    ap.add_argument("--online", action="store_true",
+                    help="co-run the online trainer (lightgbm_tpu/online): "
+                         "a feeder thread ingests labeled rows during every "
+                         "timed window so >= 1 retrain cycle + hot-swap "
+                         "lands inside it — the p99-under-retrain cell")
+    ap.add_argument("--online-update", default="refit",
+                    choices=["refit", "extend"],
+                    help="cycle mode for --online (refit keeps ensemble "
+                         "shapes constant, so the republish is a pure "
+                         "jit-cache hit and recompiles_steady stays 0)")
+    ap.add_argument("--online-rounds", type=int, default=4,
+                    help="boosting iterations per --online extend cycle")
     ap.add_argument("--warm-max-rows", type=int, default=0,
                     help="cap the warmed coalesced-batch size (0 = the "
                          "worst case, one whole window in one batch); only "
@@ -89,7 +108,7 @@ def _train_model(seed, rows, features, iterations, num_leaves):
     b = GBDT(cfg, ds, create_objective(cfg.objective, cfg))
     for _ in range(iterations):
         b.train_one_iter()
-    return b, X
+    return b, X, y
 
 
 def _tile_rows(pool, n):
@@ -170,20 +189,6 @@ def main(argv=None):
         obs.configure(out=args.telemetry_out, entry="bench_serve")
     qps_list = [float(q) for q in args.qps.split(",") if q]
     rows_list = [int(r) for r in args.request_rows.split(",") if r]
-    models = {}
-    pools = {}
-    for i in range(max(args.models, 1)):
-        b, X = _train_model(args.seed + i, args.rows, args.features,
-                            args.iterations, args.num_leaves)
-        models["m%d" % i] = b
-        pools["m%d" % i] = X
-    names = sorted(models)
-    pool = pools[names[0]]
-    server = Server(max_batch_wait_us=args.max_batch_wait_us,
-                    single_row_fast=args.single_row_fast)
-    entries = {name: server.register(name, b)
-               for name, b in models.items()}
-
     # warmup must cover every ladder rung the timed window can REACH, not
     # just the per-request sizes: the scheduler retargets shape_bucket()
     # after each absorb, so an overloaded window merges backlog into
@@ -197,24 +202,93 @@ def main(argv=None):
     top = shape_bucket(worst)
     warm_rungs = tuple(b for b in PREDICT_BUCKETS if b <= top) or \
         (PREDICT_BUCKETS[0],)
-    for name in names:
-        entries[name].warm(warm_rungs)
+    controller = None
+    if args.online:
+        # one model behind the train-while-serve controller: the trainer
+        # co-runs with every timed window (a feeder ingests labeled rows
+        # mid-window, the rows-cadence trigger fires, the cycle publishes
+        # through swap while requests keep arriving)
+        from lightgbm_tpu import serve_and_train
+        feed_rows = 2048
+        b0, X0, y0 = _train_model(args.seed, args.rows, args.features,
+                                  args.iterations, args.num_leaves)
+        controller = serve_and_train(
+            b0, name="m0",
+            params={"objective": "regression", "verbosity": -1,
+                    "max_batch_wait_us": args.max_batch_wait_us,
+                    "serve_single_row_fast": args.single_row_fast,
+                    "online_update": args.online_update,
+                    "online_rounds": args.online_rounds,
+                    "online_min_rows": feed_rows,
+                    "online_window_rows": feed_rows,
+                    "online_poll_s": 0.02,
+                    "online_drift_trigger": False},
+            warm=warm_rungs)   # every publish pre-compiles the rungs
+        server = controller.server
+        names = ["m0"]
+        pool = X0
+        pools = {"m0": X0}
+        feed_state = {"n": 0}
+
+        def _feed_once():
+            # fast host work only (a list append + counter bump): the
+            # trainer thread does the heavy lifting co-running with the
+            # open-loop arrival schedule
+            rng_f = np.random.RandomState(900 + feed_state["n"])
+            idx = rng_f.randint(0, len(X0), feed_rows)
+            controller.ingest(X0[idx].astype(np.float64), y0[idx])
+            feed_state["n"] += 1
+    else:
+        models = {}
+        pools = {}
+        for i in range(max(args.models, 1)):
+            b, X, _ = _train_model(args.seed + i, args.rows, args.features,
+                                   args.iterations, args.num_leaves)
+            models["m%d" % i] = b
+            pools["m%d" % i] = X
+        names = sorted(models)
+        pool = pools[names[0]]
+        server = Server(max_batch_wait_us=args.max_batch_wait_us,
+                        single_row_fast=args.single_row_fast)
+        entries = {name: server.register(name, b)
+                   for name, b in models.items()}
+
+    if args.online:
+        # the initial publish in start() already warmed warm_rungs; one
+        # pass through the full serve path covers the request shapes
         for r in sorted(set(rows_list)):
-            # and once through the full serve path (single-row fast compile)
-            server.predict(name, _tile_rows(pool, r)[:r], raw_score=True)
+            server.predict("m0", _tile_rows(pool, r)[:r], raw_score=True)
+        # one warmup cycle compiles the trainer-side programs (window
+        # binning/refit/extend + the republished generation's predictors)
+        # so the timed windows measure serving-under-retrain, not compiles
+        _feed_once()
+        assert controller.flush(timeout=300), "warmup cycle never finished"
+    else:
+        for name in names:
+            entries[name].warm(warm_rungs)
+            for r in sorted(set(rows_list)):
+                # and once through the full serve path (single-row fast
+                # compile)
+                server.predict(name, _tile_rows(pool, r)[:r],
+                               raw_score=True)
     base_recompiles = recompile.total()
 
     swap_seq = [0]
 
     def make_swap_fn():
+        if args.online:
+            # mid-window the feeder crosses the rows-cadence trigger; the
+            # trainer thread trains + swaps CO-RUNNING with the rest of
+            # the arrival schedule — the cell measures p99 under retrain
+            return _feed_once
         # train the replacement BEFORE the timed window opens: the swap
         # call inside the arrival loop must only flip the name, or the
         # cell's p50/p99 measure a training stall (and the burst catching
         # the schedule back up) instead of serving-under-swap
         swap_seq[0] += 1
-        b_new, _ = _train_model(args.seed + 1000 + swap_seq[0], args.rows,
-                                args.features, args.iterations,
-                                args.num_leaves)
+        b_new, _, _ = _train_model(args.seed + 1000 + swap_seq[0],
+                                   args.rows, args.features,
+                                   args.iterations, args.num_leaves)
         return lambda: server.swap(names[-1], b_new, warm=warm_rungs)
 
     grid = []
@@ -223,7 +297,12 @@ def main(argv=None):
             cell = run_cell(server, names, pool, req_rows, qps,
                             args.seconds,
                             swap_fn=make_swap_fn()
-                            if args.swap_mid_run else None)
+                            if (args.swap_mid_run or args.online)
+                            else None)
+            if args.online:
+                # the cycle the feeder triggered must land before the next
+                # cell so every window carries exactly one retrain+swap
+                controller.flush(timeout=300)
             grid.append(cell)
             print("qps=%-8g rows=%-5d p50=%s p99=%s achieved=%s failed=%d"
                   % (qps, req_rows,
@@ -233,19 +312,27 @@ def main(argv=None):
                      else "%.0f" % cell["achieved_qps"],
                      cell["failed"]), flush=True)
     stats = server.stats()
-    server.close()
+    online_stats = None
+    if controller is not None:
+        online_stats = controller.stats()
+        controller.close()
+    else:
+        server.close()
     steady_recompiles = recompile.total() - base_recompiles
     # headline: worst p99 across the grid (the SLO a fleet must plan for)
     p99s = [c["p99_s"] for c in grid if c["p99_s"] is not None]
+    swaps = (int(stats["registry"]["swaps"]) if args.online
+             else swap_seq[0])
     artifact = {
-        "metric": "serve_latency_p99_worst",
+        "metric": ("serve_latency_p99_worst_online" if args.online
+                   else "serve_latency_p99_worst"),
         "value": max(p99s) if p99s else None,
         "unit": "s",
         "qps": qps_list, "request_rows": rows_list,
         "seconds_per_cell": args.seconds,
         "models_resident": len(names),
         "swap_mid_run": bool(args.swap_mid_run),
-        "swaps": swap_seq[0],
+        "swaps": swaps,
         "single_row_fast": bool(args.single_row_fast),
         "single_row_fast_served": stats["single_row_fast"],
         "recompiles_steady": steady_recompiles,
@@ -254,6 +341,15 @@ def main(argv=None):
         "grid": grid,
         "device": os.environ.get("JAX_PLATFORMS", ""),
     }
+    if online_stats is not None:
+        artifact["online"] = {
+            "cycles": online_stats["cycles"],
+            "generation": online_stats["generation"],
+            "update": online_stats["update"],
+            "rounds": args.online_rounds,
+            "rows_ingested": online_stats["rows_ingested"],
+            "rows_behind": online_stats["rows_behind"],
+        }
     atomic_write(args.out, json.dumps(artifact, indent=1))
     print(json.dumps({k: artifact[k] for k in
                       ("metric", "value", "unit", "recompiles_steady",
@@ -264,6 +360,10 @@ def main(argv=None):
         obs.disable()
     if stats["dropped"]:
         print("FAIL: %d requests dropped" % stats["dropped"],
+              file=sys.stderr)
+        return 1
+    if args.online and swaps < 1:
+        print("FAIL: --online window finished without a retrain swap",
               file=sys.stderr)
         return 1
     if steady_recompiles:

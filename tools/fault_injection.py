@@ -60,6 +60,15 @@ Scenarios (each prints PASS/FAIL and exits nonzero on failure):
                above the alert threshold, the generation gauge flips with
                the swap, zero drops, zero steady-state recompiles, and the
                quality block survives died-run recovery from raw events.
+  online-preempt  The round-17 train-while-serve drill: SIGTERM the
+               online trainer in the middle of a retrain cycle while
+               paced traffic runs against the live generation.  The
+               cycle's persisted window + emergency checkpoint survive,
+               the process exits EXIT_PREEMPTED (75) with zero dropped
+               requests and every response bit-exact vs the generation
+               that served it, and the rerun resumes the SAME cycle and
+               publishes a next generation byte-identical (model hash)
+               to an uninterrupted run's.
   stall-capture  The round-16 flight recorder under the hang drill: the
                watchdog stall, with a telemetry run and flight_recorder
                armed, emits a kind="alert" event, triggers EXACTLY ONE
@@ -970,7 +979,203 @@ def scenario_stall_capture(workdir: str) -> None:
           % (os.path.basename(caps[0]), EXIT_STALLED))
 
 
+# ---- online-preempt: SIGTERM the trainer mid-cycle under paced traffic
+# (round 17): serving never tears, the rerun publishes the SAME next
+# generation ----
+
+_ONLINE_CHILD_SRC = r"""
+import hashlib, os, signal, sys, threading, time
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from lightgbm_tpu import resilience, serve_and_train
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+
+MODE = os.environ["ONLINE_MODE"]           # ref | kill | resume
+PREFIX = os.environ["ONLINE_PREFIX"]
+
+def base():
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-2, 2, size=(400, 5))
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2)
+         + 0.1 * rng.normal(size=400)).astype(np.float64)
+    cfg = Config(objective="regression", num_leaves=15, min_data_in_leaf=5,
+                 bagging_fraction=0.8, bagging_freq=1, verbosity=-1,
+                 num_iterations=4, snapshot_freq=2, max_bin=63)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=63,
+                                   min_data_in_leaf=5)
+    b = create_boosting(cfg.boosting, cfg, ds,
+                        create_objective(cfg.objective, cfg))
+    b.train()  # bootstrap: 4 rounds
+    return b, ds, X
+
+def fresh(seed, n=160):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2, 2, size=(n, 5))
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2)
+         + 0.1 * rng.normal(size=n)).astype(np.float64)
+    return X, y
+
+def model_hash():
+    with open(PREFIX) as fh:
+        return hashlib.sha256(fh.read().encode()).hexdigest()[:16]
+
+resilience.install_preemption_handler()
+booster, ds, Xbase = base()
+ctrl = serve_and_train(
+    booster, train_set=ds, name="m",
+    params={"objective": "regression", "verbosity": -1,
+            "snapshot_freq": 2, "online_rounds": 4,
+            "online_min_rows": 0, "online_interval_s": 0,
+            "online_drift_trigger": False, "online_poll_s": 0.05,
+            "max_batch_wait_us": 200},
+    checkpoint_prefix=PREFIX, publish_out=PREFIX)
+pool = Xbase[:64].astype(np.float32)
+sizes = (1, 17, 64)
+
+def refs():
+    return {n: ctrl.predict(pool[:n], raw_score=True) for n in sizes}
+
+def run_traffic(stop, out):
+    # paced closed-loop traffic; responses are VALIDATED after the join
+    # (a response served by a just-published generation must not race
+    # the reference capture)
+    rng = np.random.RandomState(7)
+    while not stop.is_set():
+        n = int(sizes[rng.randint(len(sizes))])
+        out.append((n, ctrl.predict(pool[:n], raw_score=True)))
+        time.sleep(0.002)
+
+if MODE == "resume":
+    # start() already loaded the published generation + the pending
+    # window; the trainer thread finishes the preempted cycle
+    deadline = time.time() + 120
+    while ctrl.cycles < 1 and time.time() < deadline:
+        if ctrl.preempted is not None:
+            raise SystemExit("re-preempted on resume")
+        time.sleep(0.05)
+    assert ctrl.cycles >= 1, "resume never published"
+    st = ctrl.stats()
+    ctrl.close()
+    assert st["serving"]["dropped"] == 0, st["serving"]
+    print("RESUMED-HASH %s" % model_hash())
+    sys.exit(0)
+
+ref_list = [refs()]
+W1 = fresh(11)
+ctrl.ingest(*W1)
+assert ctrl.run_cycle("drill"), "cycle 1 did not run"
+ref_list.append(refs())
+print("GEN2-HASH %s" % model_hash())
+
+if MODE == "kill":
+    orig_chunk = booster.train_chunk
+    state = {"n": 0}
+    def chunk(k):
+        r = orig_chunk(k)
+        state["n"] += 1
+        if state["n"] == 1:
+            signal.raise_signal(signal.SIGTERM)
+        return r
+    booster.train_chunk = chunk
+
+stop = threading.Event()
+results = []
+threads = [threading.Thread(target=run_traffic, args=(stop, results))
+           for _ in range(3)]
+for t in threads:
+    t.start()
+W2 = fresh(12)
+ctrl.ingest(*W2)
+code = 0
+try:
+    ctrl.run_cycle("drill")
+    ref_list.append(refs())
+    print("GEN3-HASH %s" % model_hash())
+except resilience.TrainingPreempted as exc:
+    print("PREEMPTED iter=%d" % exc.iteration)
+    code = resilience.EXIT_PREEMPTED
+finally:
+    stop.set()
+    for t in threads:
+        t.join()
+st = ctrl.stats()
+ctrl.close()
+assert st["serving"]["dropped"] == 0, st["serving"]
+bad = sum(1 for n, got in results
+          if not any(np.array_equal(got, r[n]) for r in ref_list))
+assert results and bad == 0, \
+    "%d/%d responses matched no generation" % (bad, len(results))
+print("TRAFFIC-OK n=%d dropped=%d" % (len(results),
+                                      st["serving"]["dropped"]))
+sys.exit(code)
+"""
+
+
+def scenario_online_preempt(workdir: str) -> None:
+    """The round-17 train-while-serve preemption drill: SIGTERM lands in
+    the middle of an online retrain cycle while paced traffic runs.  The
+    trainer exits through the emergency-checkpoint path (exit 75), every
+    response before/during/after stays bit-exact vs the generation that
+    served it with zero drops, and the rerun resumes the persisted
+    window + checkpoint and publishes the SAME next generation
+    (model-hash equality vs an uninterrupted run)."""
+    import glob as _glob
+
+    from lightgbm_tpu.resilience import EXIT_PREEMPTED
+
+    def marker(stdout, tag):
+        for line in stdout.splitlines():
+            if line.startswith(tag):
+                return line.split()[1]
+        raise AssertionError("no %r marker in:\n%s" % (tag, stdout))
+
+    # uninterrupted reference: two explicit cycles, hashes per generation
+    ref_prefix = os.path.join(workdir, "online_ref.txt")
+    p = _run_child(_ONLINE_CHILD_SRC, {"ONLINE_MODE": "ref",
+                                       "ONLINE_PREFIX": ref_prefix})
+    assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+    assert "TRAFFIC-OK" in p.stdout, p.stdout
+    ref_g2 = marker(p.stdout, "GEN2-HASH")
+    ref_g3 = marker(p.stdout, "GEN3-HASH")
+
+    # the kill run: SIGTERM after the first chunk of cycle 2
+    prefix = os.path.join(workdir, "online_kill.txt")
+    p = _run_child(_ONLINE_CHILD_SRC, {"ONLINE_MODE": "kill",
+                                       "ONLINE_PREFIX": prefix})
+    assert p.returncode == EXIT_PREEMPTED, \
+        "expected exit %d (resumable), got %r: %s" % (
+            EXIT_PREEMPTED, p.returncode, p.stdout + p.stderr[-2000:])
+    assert "PREEMPTED" in p.stdout and "TRAFFIC-OK" in p.stdout, p.stdout
+    assert marker(p.stdout, "GEN2-HASH") == ref_g2, \
+        "generation 2 diverged before the preemption"
+    # the cycle's durability files survived for the resume
+    assert os.path.exists(prefix + ".online_window.npz"), \
+        "persisted window missing"
+    assert _glob.glob(prefix + ".ckpt_iter_*"), \
+        "emergency checkpoint missing"
+
+    # the rerun: resumes the window + checkpoint, publishes the SAME
+    # next generation the uninterrupted run would have
+    p = _run_child(_ONLINE_CHILD_SRC, {"ONLINE_MODE": "resume",
+                                       "ONLINE_PREFIX": prefix})
+    assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+    got = marker(p.stdout, "RESUMED-HASH")
+    assert got == ref_g3, \
+        "resumed generation %s != uninterrupted %s" % (got, ref_g3)
+    assert not os.path.exists(prefix + ".online_window.npz"), \
+        "window file not consumed by the resumed cycle"
+    print("PASS online-preempt: SIGTERM mid-cycle under paced traffic -> "
+          "exit %d with 0 drops and every response bit-exact per "
+          "generation; rerun resumed the persisted window and published "
+          "the same next generation (%s)" % (EXIT_PREEMPTED, ref_g3))
+
+
 SCENARIOS = {"kill-write": scenario_kill_write,
+             "online-preempt": scenario_online_preempt,
              "stall-capture": scenario_stall_capture,
              "swap-under-load": scenario_swap_under_load,
              "drift-swap": scenario_drift_swap,

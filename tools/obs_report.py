@@ -168,6 +168,12 @@ def summary_from_events(events):
     alert_rules = {}
     alerts_fired = 0
     captures = []
+    # online-learning recovery: kind="online_cycle" events rebuild the
+    # cycles-by-trigger table and the last generation/rows_behind gauges
+    # a died train-while-serve run never summarized
+    onl_counters = {}
+    onl_gauges = {}
+    onl_hists = {}
     n_events = 0
     for e in events:
         n_events += 1
@@ -217,6 +223,20 @@ def summary_from_events(events):
             captures.append({k: e.get(k) for k in
                              ("n", "reason", "dir", "seconds", "error")
                              if e.get(k) is not None})
+        if e["kind"] == "online_cycle":
+            onl_counters["online_cycles"] = \
+                onl_counters.get("online_cycles", 0) + 1
+            trig = "online_trigger_%s" % e.get("trigger", "?")
+            onl_counters[trig] = onl_counters.get(trig, 0) + 1
+            if e.get("generation") is not None:
+                onl_gauges["online_generation"] = e["generation"]
+            if e.get("rows_behind") is not None:
+                onl_gauges["online_rows_behind"] = e["rows_behind"]
+            for field, hname in (("train_s", "online_train_s"),
+                                 ("publish_s", "online_publish_s")):
+                if isinstance(e.get(field), (int, float)):
+                    onl_hists.setdefault(hname,
+                                         Histogram()).observe(e[field])
         if e["kind"] == "serve_batch":
             m = str(e.get("model", "?"))
             for ck, n in (("serve_batches", 1),
@@ -293,6 +313,7 @@ def summary_from_events(events):
                  "feature_max": e.get("feature_max"),
                  "score_psi": e.get("score_psi"),
                  "level": e.get("level"),
+                 "rows_behind": e.get("rows_behind"),
                  "features": feats}
         if agg["ranks"] > 1:
             entry["ranks"] = agg["ranks"]
@@ -302,6 +323,9 @@ def summary_from_events(events):
             q_models[m] = entry
     quality = ({"models": q_models, "generations": q_gens}
                if q_models else None)
+    from lightgbm_tpu.obs.report import online_block
+    online = online_block(onl_counters, onl_gauges,
+                          {k: h.summary() for k, h in onl_hists.items()})
     compile_block = None
     if compile_keys:
         compile_block = {
@@ -326,6 +350,7 @@ def summary_from_events(events):
     return {
         **({"serving": serving} if serving else {}),
         **({"quality": quality} if quality else {}),
+        **({"online": online} if online else {}),
         **({"compile": compile_block} if compile_block else {}),
         **({"alerts": alerts_block} if alerts_block else {}),
         **({"profiling": {"captures": captures, "recovered": True}}

@@ -109,6 +109,27 @@ def gate_serve(g: Gate, path: str, doc: dict, b: dict, baseline) -> None:
                 int(doc["recompiles_steady"])
                 <= int(b.get("recompiles_steady", 0)),
                 "recompiles_steady=%s" % doc["recompiles_steady"])
+    online = doc.get("online")
+    if online is not None:
+        # the train-while-serve cell (bench_serve --online): the timed
+        # windows are only evidence of serving-under-retrain if a swap
+        # actually landed inside them
+        g.check(path, "online retrain swaps", int(doc.get("swaps", 0)) >= 1,
+                "swaps=%s cycles=%s" % (doc.get("swaps"),
+                                        online.get("cycles")))
+        factor = b.get("serve_p99_online_factor")
+        if factor and baseline and baseline.get("value"):
+            worst = float(doc.get("value", 0.0))
+            base = float(baseline["value"])
+            g.check(path, "online p99 vs serve baseline",
+                    worst <= base * float(factor),
+                    "p99-under-retrain %.4gs vs serve %.4gs "
+                    "(bar %.4gs = %.2fx)"
+                    % (worst, base, base * float(factor), float(factor)))
+        elif factor:
+            g.skip(path, "online p99 vs serve baseline",
+                   "no serve baseline artifact")
+        return
     factor = b.get("serve_p99_regression")
     if factor and baseline and baseline.get("value"):
         worst = float(doc.get("value", 0.0))
