@@ -1115,6 +1115,23 @@ class GBDT:
         if compile_key is not None:
             _compile.note_dispatch(tele, "fused_train", compile_key, dt,
                                    int(compiles))
+        # kernel-plan provenance (round 18): the fused-scan path consumes
+        # the learner's resolved plan through bucket_plan without calling
+        # learner.train, so the stamp rides the chunk telemetry (deduped
+        # per run by plan.state)
+        learner = getattr(self, "learner", None)
+        if learner is not None:
+            from ..plan import state as _plan_state
+            plan = getattr(learner, "plan", None)
+            prov = plan.provenance if plan is not None else "analytic"
+            if getattr(learner, "bucket_plan", None) is not None \
+                    and prov == "analytic":
+                prov = "pinned"
+            _plan_state.stamp(tele, "tree_build", prov,
+                              key="n%d_b%d" % (int(learner.num_data),
+                                               int(learner.num_bins)),
+                              mode=str(getattr(learner, "tree_grow_mode",
+                                               "leaf")))
         # HBM high-water stamp per chunk (obs/devmem.py): import-safe,
         # quietly empty on backends without memory_stats
         _devmem.sample(tele, phase="train_chunk")

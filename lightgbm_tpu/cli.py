@@ -78,6 +78,12 @@ class Application:
         self.config = Config(self.params)
         Log.reset_level(Log.level_from_verbosity(int(self.config.verbosity)))
         enable_compilation_cache()
+        # round-18 kernel planner: the tuned-plan cache lives next to the
+        # XLA compilation cache (plan_cache param overrides); absent =
+        # analytic plans, unusable = analytic + one warning + the
+        # plan_cache_fallbacks counter
+        from .plan import state as _plan_state
+        _plan_state.configure_from_config(self.config)
 
     def run(self) -> None:
         task = self.config.task

@@ -306,6 +306,10 @@ class Config:
             Log.warning("flight_recorder=true without a telemetry run "
                         "(telemetry_out/metrics_port); no capture can be "
                         "armed")
+        # round-18 kernel-planner param: validation of the plan_cache path
+        # lives at engagement (plan/state.configure) — an unusable or
+        # missing explicit cache warns once there and bumps the always-on
+        # plan_cache_fallbacks counter; warning here too would double up
         # round-17 online-learning params
         self.online_update = str(self.online_update).lower()
         if self.online_update not in ("extend", "refit"):

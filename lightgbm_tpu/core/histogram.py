@@ -42,6 +42,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..plan import device_specs as _device_specs
+from ..plan import state as _plan_state
+
 _LANE = 128
 
 
@@ -348,14 +351,27 @@ def _use_factored(num_features: int, num_bins: int) -> bool:
     (row, feature) plus a p x p all-pairs MXU block per feature group (only
     the diagonal is read) — per-feature cost near-independent of B, so it
     wins essentially everywhere the accumulator fits on-chip.  The bound
-    below caps the [G*128, p*nlo] f32 accumulator at 4 MiB of VMEM (it
-    lives alongside the partition kernel's ~5 MiB of round-6 pipelined
-    streaming scratch — NIN=3 input ring + double-banked placement tiles —
-    inside the ~16 MiB v5e VMEM)."""
+    below caps the [G*128, p*nlo] f32 accumulator at the device's
+    accumulator budget — a quarter of VMEM, 4 MiB on the 16 MiB v5e
+    (``plan/device_specs.py``, round 18: previously a literal here) — so
+    it fits alongside the partition kernel's ~5 MiB of round-6 pipelined
+    streaming scratch (NIN=3 input ring + double-banked placement tiles).
+
+    A PINNED kernel plan (``plan/state.py``, tests and the autotuner)
+    overrides the choice outright; the layout is baked into compiled
+    programs, so the override is engage-time-only by contract — never
+    flipped under a live jit cache."""
+    override = _plan_state.hist_layout_override(num_features, num_bins)
+    if override is not None:
+        return override
     if num_bins < 32:
         return False
     out = _factored_out_shape(num_features, num_bins)
-    return out[0] * out[1] * 4 <= (4 << 20)
+    # budget keyed by the ATTACHED device (memoized probe) so the gate
+    # agrees with the budget analytic_plan records into Plan/artifacts
+    budget = _device_specs.hist_accum_budget_bytes(
+        _device_specs.current_device_kind())
+    return out[0] * out[1] * 4 <= budget
 
 
 def _accum_factored_group(ti_bf, v4T, out_ref, g, *, num_features: int,

@@ -49,6 +49,8 @@ from ..obs import active as _telemetry_active
 from ..obs import annotate as _annotate
 from ..obs import compile as _compile
 from ..obs import recompile as _recompile
+from ..plan import device_specs as _device_specs
+from ..plan import state as _plan_state
 from ..utils.timer import FunctionTimer
 from .predict import (EnsembleArrays, _path_matrix, decide_raw,
                       stack_ensemble_host)
@@ -56,8 +58,11 @@ from .tree import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, Tree
 
 # path-matrix VMEM budget per scan block (f32 bytes) and the block-width cap;
 # the same discipline as partition.fused_bucket_plan: sizes are host-static,
-# derived only from the model shape, so the dispatch never retraces.
-BLOCK_VMEM_BYTES = 1 << 20
+# derived only from the model shape, so the dispatch never retraces.  The
+# budget constant moved to plan/device_specs.py (round 18, one source of
+# truth per device_kind); a tuned/pinned kernel plan overrides it through
+# plan/state.py at stack time.
+BLOCK_VMEM_BYTES = _device_specs.PREDICT_BLOCK_VMEM_BYTES
 BLOCK_MAX = 64
 
 # fixed row-padding ladder: any batch size compiles at most len() programs
@@ -66,12 +71,20 @@ BLOCK_MAX = 64
 PREDICT_BUCKETS = (128, 1024, 8192, 65536, 524288)
 
 
-def tree_block(t: int, m: int, l: int) -> int:
+def tree_block(t: int, m: int, l: int,
+               vmem_bytes: Optional[int] = None) -> int:
     """Trees per scan block: the largest count whose stacked [G, M, L] path
-    matrices fit BLOCK_VMEM_BYTES, rebalanced so the final block is not
-    ragged (T=100 at cap 32 -> 4 blocks of 25, zero pad trees)."""
+    matrices fit the block VMEM budget, rebalanced so the final block is
+    not ragged (T=100 at cap 32 -> 4 blocks of 25, zero pad trees).
+
+    The budget defaults through the kernel planner (round 18): a pinned
+    or tuned plan's ``predict_block_vmem_bytes`` wins, else the
+    device-spec constant — byte-equal to the historical sizing when no
+    plan cache is engaged."""
+    if vmem_bytes is None:
+        vmem_bytes = _plan_state.predict_block_vmem() or BLOCK_VMEM_BYTES
     per_tree = max(m * l * 4, 1)
-    cap = max(1, min(BLOCK_MAX, BLOCK_VMEM_BYTES // per_tree, max(t, 1)))
+    cap = max(1, min(BLOCK_MAX, int(vmem_bytes) // per_tree, max(t, 1)))
     n_blocks = -(-max(t, 1) // cap)
     return -(-max(t, 1) // n_blocks)
 
@@ -353,6 +366,16 @@ class FusedPredictor:
         else:
             self.ens = (stack_ensemble_binned_blocked(trees, dataset)
                         if trees else None)
+        # plan provenance (round 18): which planner sized this stacking's
+        # tree-block G — stamped once per run so BENCH/serving artifacts
+        # record the plan behind every latency number
+        tele = _telemetry_active()
+        if tele is not None and self.ens is not None:
+            _plan_state.stamp(
+                tele, "predict_fused", _plan_state.current_provenance(),
+                key="t%d_g%d" % (self.n_trees,
+                                 int(self.ens.path_len.shape[1])),
+                store=self.kind, g=int(self.ens.path_len.shape[1]))
 
     def _prep_rows(self, X) -> np.ndarray:
         if self.kind == "raw":

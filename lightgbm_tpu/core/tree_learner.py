@@ -1568,6 +1568,15 @@ class SerialTreeLearner:
             self.cegb_paid = jnp.zeros(
                 (self.num_data + self.padded_rows,
                  -(-dataset.num_features // 8)), jnp.uint8)
+        # round-18 kernel planner (lightgbm_tpu/plan): ONE resolution
+        # covers the fused bucket schedule AND the level ladder —
+        # gbdt.py's fused-scan paths inherit both through bucket_plan.
+        # An analytic plan is byte-equal to the schedule the builder
+        # derives itself, so bucket_plan stays None (identical jit keys,
+        # behavior-neutral by default); a tuned/pinned plan installs its
+        # schedule here and is stamped into telemetry at train time.
+        self.plan = None
+        self._resolve_plan()
 
     @staticmethod
     def _map_feature_contri(config, dataset) -> tuple:
@@ -1667,6 +1676,34 @@ class SerialTreeLearner:
             return jnp.pad(arr, pad_width, constant_values=value)
         return arr
 
+    def _resolve_plan(self) -> None:
+        """Consume the kernel planner (plan/state.py: pinned > tuned
+        cache > analytic).  Only a non-analytic plan changes anything:
+        its ladder is installed as the trace-static ``bucket_plan``
+        (level mode consumes the plan's level ladder — same object
+        analytically).  Never raises: planning failures degrade to the
+        derived-in-builder schedule."""
+        try:
+            from ..plan import state as _plan_state
+            if hasattr(self, "bins"):
+                n = int(self.bins.shape[0])
+                bpc = 2 if self.bins.dtype == jnp.uint16 else 1
+            else:
+                n = int(self.num_data + self.padded_rows)
+                bpc = 2 if self.num_bins > 256 else 1
+            self.plan = _plan_state.resolve(
+                n, int(self.dataset.num_features), int(self.num_bins),
+                bpc=bpc, packed=bool(self.packed_cols),
+                num_class=int(getattr(self.config, "num_class", 1) or 1))
+            if self.plan.provenance != "analytic" \
+                    and self.bucket_plan is None:
+                ladder = (self.plan.level_ladder
+                          if self.tree_grow_mode == "level"
+                          else self.plan.bucket_plan)
+                self.bucket_plan = tuple(ladder)
+        except Exception:  # noqa: BLE001 - planner must never fail a build
+            self.plan = None
+
     def effective_grow_mode(self) -> str:
         """The growth mode this learner's builds actually run: ``level``
         only when the fused Pallas path is live and no leaf-wise-only
@@ -1691,8 +1728,29 @@ class SerialTreeLearner:
                 Log.warning("tree_grow_mode=level unavailable (%s); growing "
                             "leaf-wise", "; ".join(blockers))
                 self._grow_mode_warned = True
+            self._sync_plan_ladder("leaf")
             return "leaf"
+        self._sync_plan_ladder("level")
         return "level"
+
+    def _sync_plan_ladder(self, mode: str) -> None:
+        """Keep a PLANNER-installed bucket_plan aligned with the mode that
+        actually dispatches: construction installs the ladder for the
+        CONFIGURED grow mode, but the effective mode can degrade (or be
+        test-flipped) afterwards, and a tuned cache may legally carry
+        different leaf vs level ladders.  Only a schedule this planner
+        installed is swapped — a directly-pinned bucket_plan (tests, the
+        autotuner) is never touched."""
+        plan = self.plan
+        if plan is None or plan.provenance == "analytic" \
+                or self.bucket_plan is None:
+            return
+        ladders = (tuple(plan.bucket_plan), tuple(plan.level_ladder))
+        if self.bucket_plan not in ladders:
+            return  # pinned by hand, not by the planner
+        want = ladders[1] if mode == "level" else ladders[0]
+        if self.bucket_plan != want:
+            self.bucket_plan = want
 
     def level_classes(self) -> int:
         """Bucket-class count of the level-batched dispatch schedule."""
@@ -1749,6 +1807,18 @@ class SerialTreeLearner:
                               classes=self.level_classes())
             span_ctx = _spans.Span(tele, "tree_build", tele.trace_id,
                                    None, fields)
+            # plan provenance (round 18): a directly-pinned bucket_plan
+            # (tests, the autotuner's candidate sweeps) reports "pinned"
+            # even though the resolved plan was analytic — the stamp
+            # records what actually dispatched
+            from ..plan import state as _plan_state
+            prov = (self.plan.provenance if self.plan is not None
+                    else "analytic")
+            if self.bucket_plan is not None and prov == "analytic":
+                prov = "pinned"
+            _plan_state.stamp(tele, "tree_build", prov,
+                              key="n%d_b%d" % (self.num_data, self.num_bins),
+                              mode=grow_mode)
         with span_ctx, FunctionTimer("Partition::BuildTree(dispatch)"), \
                 _annotate("partition_build_tree"):
             out = build_tree_partitioned(

@@ -92,6 +92,18 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         train_set.categorical_feature = categorical_feature
     params["num_iterations"] = num_boost_round
 
+    # round-18 kernel planner: engage the persisted tuned-plan cache (the
+    # plan_cache param, or the default location next to the XLA cache)
+    # BEFORE the Booster constructs its tree learner — the learner
+    # resolves its dispatch plan at construction.  No cache present (the
+    # default) means analytic plans, byte-equal to the hand-tuned
+    # constants; an unusable cache degrades to analytic with one warning
+    # and the plan_cache_fallbacks counter.
+    from .plan import state as _plan_state
+    _plan_state.configure(
+        str(alias_transform(dict(params)).get("plan_cache", "") or "")
+        or None)
+
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
         if isinstance(init_model, str):
@@ -358,6 +370,10 @@ def serve(models, params: Optional[Dict[str, Any]] = None, **server_kwargs):
 
     cfg = Config(alias_transform(dict(params or {})))
     own_tele = _configure_owned_telemetry(cfg, "engine.serve")
+    # tuned-plan cache (round 18): engaged before any predictor stacks so
+    # the warmup compiles under the plan the run will serve with
+    from .plan import state as _plan_state
+    _plan_state.configure_from_config(cfg)
     server = None
     try:
         # the run stays open for telemetry_summary() reads while serving;
@@ -421,6 +437,8 @@ def serve_and_train(booster, train_set=None,
 
     cfg = Config(alias_transform(dict(params or {})))
     own_tele = _configure_owned_telemetry(cfg, "engine.serve_and_train")
+    from .plan import state as _plan_state
+    _plan_state.configure_from_config(cfg)
     server = None
     try:
         server = Server(config=cfg, owned_telemetry=own_tele,

@@ -128,7 +128,7 @@ def render_prometheus(snapshot: Dict[str, Any],
     # exposition — Prometheus fails the whole scrape); the labeled
     # process-wide block is the richer one, so it wins
     mirrored = ("recompiles", "tree_kernel_launches", "predict_fallbacks",
-                "io_retries")
+                "io_retries", "plan_cache_fallbacks")
     for name, v in sorted(snapshot.get("counters", {}).items()):
         if name in mirrored:
             continue
@@ -169,6 +169,12 @@ def render_prometheus(snapshot: Dict[str, Any],
            or ["%s 0" % fb])
     io = _PREFIX + "io_retries_total"
     metric(io, "counter", ["%s %d" % (io, io_retry_count())])
+    # plan-cache degradations (round 18, plan/cache.py): analytic
+    # fallbacks from a corrupt/stale/mismatched tuned-plan cache — an
+    # always-on counter like the resilience set above
+    from ..plan.cache import fallback_count as _plan_fallbacks
+    pf = _PREFIX + "plan_cache_fallbacks_total"
+    metric(pf, "counter", ["%s %d" % (pf, _plan_fallbacks())])
     # model-quality plane (obs/quality.py): labeled per-model gauges,
     # rendered only when the run monitors traffic (no stale exposition)
     models = (quality or {}).get("models") or {}

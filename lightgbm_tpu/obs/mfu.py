@@ -17,22 +17,18 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-# v5e peaks, exported: the historical BENCH convention quotes proxy-box
-# (no-accelerator) utilization against these so the trajectory stays
-# comparable — bench.py references them instead of re-hardcoding
-V5E_PEAK_BW = 819e9      # HBM bytes/s
-V5E_PEAK_MACS = 98.5e12  # bf16 MACs/s (197 TFLOP/s)
+# Device hardware tables live in plan/device_specs.py (round 18: ONE
+# source of truth per device_kind, shared with the kernel planner).  The
+# v5e peaks stay exported under their historical names — the BENCH
+# convention quotes proxy-box (no-accelerator) utilization against them
+# so the trajectory stays comparable, and bench.py references them
+# instead of re-hardcoding.
+from ..plan.device_specs import V5E_PEAK_BW, V5E_PEAK_MACS  # noqa: F401
+from ..plan.device_specs import device_peaks_table as _device_peaks_table
 
-# (peak HBM bytes/s, peak bf16 MACs/s) by device_kind substring, checked in
-# order.  MACs = FLOP/2 (the reference numbers quote FLOP/s).
-_DEVICE_PEAKS = (
-    ("v5 lite", (V5E_PEAK_BW, V5E_PEAK_MACS)),
-    ("v5e", (V5E_PEAK_BW, V5E_PEAK_MACS)),
-    ("v5p", (2765e9, 229e12)),       # v5p: 2.765 TB/s, 459 bf16 TFLOP/s
-    ("v4", (1228e9, 137.5e12)),      # v4: 1.228 TB/s, 275 bf16 TFLOP/s
-    ("v3", (900e9, 61.5e12)),        # v3: 900 GB/s, 123 bf16 TFLOP/s
-    ("v6", (1640e9, 459e12)),        # v6e (Trillium): 1.64 TB/s, 918 TFLOP/s
-)
+# (peak HBM bytes/s, peak bf16 MACs/s) by device_kind substring, checked
+# in order.  MACs = FLOP/2 (the reference numbers quote FLOP/s).
+_DEVICE_PEAKS = _device_peaks_table()
 
 
 def device_peaks(device=None) -> Optional[Dict[str, float]]:

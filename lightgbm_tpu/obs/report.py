@@ -245,6 +245,26 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
         out["alerts"] = {"enabled": False, "series": [],
                          "fired_total": int(snap["counters"]
                                             ["alerts_fired"])}
+    # kernel-plan provenance (round 18, lightgbm_tpu/plan): which planner
+    # produced the dispatch shapes behind this artifact's numbers —
+    # analytic | tuned | pinned per site, plus the engaged cache and the
+    # always-on fallback counter.  BENCH artifacts carry this so a tuned
+    # number is never mistaken for an analytic one (perf_gate checks it).
+    stamps = getattr(tele, "plan_stamps", None)
+    if stamps:
+        from ..plan import cache as _plan_cache
+        from ..plan import state as _plan_state
+        sites = {site: {k: v for k, v in info.items() if k != "_tag"}
+                 for site, info in stamps.items()}
+        provs = {info["provenance"] for info in sites.values()}
+        headline = ("pinned" if "pinned" in provs
+                    else "tuned" if "tuned" in provs else "analytic")
+        out["plan"] = {
+            "provenance": headline,
+            "sites": sites,
+            "cache_path": _plan_state.configured_path(),
+            "cache_fallbacks": _plan_cache.fallback_count(),
+        }
     # model-quality rollup (obs/quality.py): per-model drift PSI/JS ranked
     # by importance, score PSI, generation + freshness — present only when
     # the run monitored traffic
@@ -352,6 +372,15 @@ def human_table(summary: Dict[str, Any]) -> str:
                 row("    " + key, "n=%d p50=%.6g p99=%.6g"
                     % (h["count"], h.get("p50", float("nan")),
                        h.get("p99", float("nan"))))
+    plan = summary.get("plan") or {}
+    if plan:
+        row("plan provenance", "%s (cache=%s, fallbacks=%d)"
+            % (plan.get("provenance", "analytic"),
+               plan.get("cache_path") or "-",
+               plan.get("cache_fallbacks", 0)))
+        for site, info in sorted((plan.get("sites") or {}).items()):
+            row("  plan[%s]" % site, "%s %s"
+                % (info.get("provenance"), info.get("key") or ""))
     comp = summary.get("compile") or {}
     if comp.get("keys"):
         lines.append("  compile:")

@@ -1,0 +1,53 @@
+"""Unified kernel-planning layer with a persisted autotuner (round 18).
+
+One planner — (shape-class, dtype/packing, classes, device_kind, VMEM
+budget) -> typed :class:`~.planner.Plan` — covering the four dispatch
+sites that previously each reinvented VMEM budgeting: the fused-split
+bucket schedule, the level-mode window ladder, the histogram layout
+chooser, and the predict tree-block sizing.  See ``plan/planner.py`` for
+the design contract, ``plan/state.py`` for the resolution entry point,
+``plan/cache.py`` for the persisted tuned-plan cache and its fail-safe
+fallback, ``plan/autotune.py`` for the empirical mode, and
+``plan/device_specs.py`` for the per-device hardware tables.
+
+IMPORT DISCIPLINE: ``core/histogram.py`` and ``core/predict_fused.py``
+import ``plan.device_specs`` at module load, which executes this package
+``__init__`` first — so everything here is lazy (PEP 562).  Importing
+``lightgbm_tpu.plan`` pulls in no jax, no core, nothing.
+"""
+from __future__ import annotations
+
+_SUBMODULES = ("autotune", "cache", "device_specs", "planner", "state")
+
+# the package-level convenience API, resolved lazily
+_LAZY = {
+    "Plan": ("planner", "Plan"),
+    "ShapeClass": ("planner", "ShapeClass"),
+    "analytic_plan": ("planner", "analytic_plan"),
+    "plan_key": ("planner", "plan_key"),
+    "shape_class": ("planner", "shape_class"),
+    "validate_plan": ("planner", "validate_plan"),
+    "resolve": ("state", "resolve"),
+    "configure": ("state", "configure"),
+    "configure_from_config": ("state", "configure_from_config"),
+    "pinned": ("state", "pinned"),
+    "stamp": ("state", "stamp"),
+    "fallback_count": ("cache", "fallback_count"),
+    "default_cache_path": ("cache", "default_cache_path"),
+}
+
+__all__ = sorted(set(_SUBMODULES) | set(_LAZY))
+
+
+def __getattr__(name):
+    import importlib
+    if name in _SUBMODULES:
+        return importlib.import_module("." + name, __name__)
+    if name in _LAZY:
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module("." + mod, __name__), attr)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,139 @@
+"""Per-device-kind hardware tables — ONE source of truth (round 18).
+
+Before this module, device constants were scattered and re-hardcoded:
+the ~16 MiB v5e VMEM note lived in a ``core/histogram.py`` docstring, the
+4 MiB factored-histogram accumulator gate was a literal in
+``_use_factored``, ``core/predict_fused.py`` carried its own
+``BLOCK_VMEM_BYTES``, and ``obs/mfu.py`` kept the HBM-bandwidth / peak-MACs
+table.  The kernel planner (``plan/planner.py``) and the MFU estimator both
+need those numbers per ``device_kind``, so they live here — adding a
+backend becomes "add a spec row + run the tuner" (ROADMAP item 4), not
+"re-derive every constant".
+
+Dependency-free by design: ``core/histogram.py`` and
+``core/predict_fused.py`` import this at module load, so it must never
+import jax, core, or obs.  ``lightgbm_tpu/plan/__init__.py`` is lazy
+(PEP 562) for the same reason.
+
+All VMEM budgets default to the v5e values every constant in the tree was
+hand-tuned for — the analytic planner must reproduce today's dispatch
+byte-for-byte on every device until the tuner measures otherwise.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+
+class DeviceSpec(NamedTuple):
+    """Hardware envelope of one accelerator kind.
+
+    ``hbm_bw`` / ``peak_macs`` are ``None`` for kinds without published
+    peaks (CPU hosts, unknown devices): utilization ratios stay ``None``
+    rather than a made-up number (obs/mfu.py contract)."""
+    kind: str                     # canonical name (substring-matched)
+    vmem_bytes: int               # per-core VMEM
+    hbm_bw: Optional[float]       # HBM bytes/s
+    peak_macs: Optional[float]    # bf16 MACs/s (FLOP/s / 2)
+
+
+# v5e peaks, exported under the historical names: the BENCH convention
+# quotes proxy-box (no-accelerator) utilization against these so the
+# trajectory stays comparable (obs/mfu.py re-exports them for bench.py)
+V5E_PEAK_BW = 819e9      # HBM bytes/s
+V5E_PEAK_MACS = 98.5e12  # bf16 MACs/s (197 TFLOP/s)
+
+# the "~16 MiB v5e VMEM" every round-5..7 kernel constant was tuned inside
+# (previously a core/histogram.py docstring note)
+V5E_VMEM_BYTES = 16 << 20
+
+# Substring-matched IN ORDER against the lowercased ``device_kind`` —
+# same matching discipline obs/mfu.py always used ("v5 lite" before "v5e"
+# so both spellings of the same chip hit one row).  MACs = FLOP/2 (the
+# reference numbers quote FLOP/s).
+SPECS = (
+    DeviceSpec("v5 lite", V5E_VMEM_BYTES, V5E_PEAK_BW, V5E_PEAK_MACS),
+    DeviceSpec("v5e", V5E_VMEM_BYTES, V5E_PEAK_BW, V5E_PEAK_MACS),
+    DeviceSpec("v5p", 16 << 20, 2765e9, 229e12),   # 2.765 TB/s, 459 TFLOP/s
+    DeviceSpec("v4", 16 << 20, 1228e9, 137.5e12),  # 1.228 TB/s, 275 TFLOP/s
+    DeviceSpec("v3", 16 << 20, 900e9, 61.5e12),    # 900 GB/s, 123 TFLOP/s
+    DeviceSpec("v6", 32 << 20, 1640e9, 459e12),    # v6e: 1.64 TB/s, 918 TF
+)
+
+# unknown device (CPU hosts, new backends): v5e-shaped VMEM budgets keep
+# the analytic planner byte-equal to the hand-tuned constants; no peaks
+DEFAULT_SPEC = DeviceSpec("unknown", V5E_VMEM_BYTES, None, None)
+
+# path-matrix VMEM budget per predict scan block (f32 bytes) — the former
+# ``predict_fused.BLOCK_VMEM_BYTES`` literal; device-independent until the
+# tuner says otherwise
+PREDICT_BLOCK_VMEM_BYTES = 1 << 20
+
+
+def spec_for(device_kind: Optional[str]) -> DeviceSpec:
+    """The spec row of ``device_kind`` (substring match, first hit), or
+    :data:`DEFAULT_SPEC` — never ``None``, so every budget has a value."""
+    kind = str(device_kind or "").lower()
+    for spec in SPECS:
+        if spec.kind in kind:
+            return spec
+    return DEFAULT_SPEC
+
+
+def hist_accum_budget_bytes(device_kind: Optional[str] = None) -> int:
+    """VMEM budget of the factored-histogram accumulator — the round-6
+    "4 MiB" gate in ``histogram._use_factored``, now derived as a quarter
+    of the device VMEM (4 MiB at the 16 MiB v5e: the accumulator lives
+    alongside the partition kernel's ~5 MiB of pipelined streaming
+    scratch — NIN=3 input ring + double-banked placement tiles)."""
+    return spec_for(device_kind).vmem_bytes // 4
+
+
+def predict_block_vmem_bytes(device_kind: Optional[str] = None) -> int:
+    """Path-matrix VMEM budget per predict scan block
+    (``predict_fused.tree_block`` sizing)."""
+    del device_kind  # device-independent until tuned
+    return PREDICT_BLOCK_VMEM_BYTES
+
+
+_current_kind_cache = None
+
+
+def current_device_kind() -> str:
+    """``device_kind`` of the attached accelerator, lowercased; ``"cpu"``
+    for non-TPU backends (matches the obs/mfu.py unknown-device
+    semantics).  jax is imported lazily and failures degrade to "cpu" —
+    the planner must resolve on any host.  Memoized after the first
+    successful probe: the device set is process-static and this is
+    called from trace-time layout choices (``histogram._use_factored``)."""
+    global _current_kind_cache
+    if _current_kind_cache is not None:
+        return _current_kind_cache
+    kind = _probe_device_kind()
+    if kind is not None:
+        _current_kind_cache = kind
+        return kind
+    return "cpu"
+
+
+def _probe_device_kind():
+    """One device probe; ``None`` when jax isn't ready yet (the memo must
+    not freeze "cpu" before the backend is initialized)."""
+    try:
+        import jax
+        devs = jax.devices()
+        if not devs:
+            return "cpu"
+        dev = devs[0]
+        if str(getattr(dev, "platform", "")).lower() != "tpu":
+            return "cpu"
+        return str(getattr(dev, "device_kind", "")).lower() or "tpu"
+    except Exception:  # noqa: BLE001 - planning must never fail a run
+        return None
+
+
+def device_peaks_table():
+    """The (substring, (bw, macs)) rows obs/mfu.py's estimator matches
+    against — only kinds WITH published peaks (unknowns return None
+    ratios there)."""
+    return tuple((s.kind, (s.hbm_bw, s.peak_macs)) for s in SPECS
+                 if s.hbm_bw is not None and s.peak_macs is not None)

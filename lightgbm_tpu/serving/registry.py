@@ -239,6 +239,19 @@ class ResidentModel:
         for b in buckets:
             self.predict(np.zeros((int(b), n_feat), dtype=np.float32),
                          raw_score=True)
+        # plan provenance (round 18): which planner sized the programs
+        # this warmup just compiled — the serving-side half of the stamp
+        # the tree builder writes at train time
+        tele = _telemetry_active()
+        if tele is not None:
+            from ..plan import state as _plan_state
+            # buckets as a comma-joined scalar: JSONL event fields must be
+            # scalars (validate_event), same convention as drift "top"
+            _plan_state.stamp(tele, "serving_warm",
+                              _plan_state.current_provenance(),
+                              key=str(self.name),
+                              buckets=",".join(str(int(b))
+                                               for b in buckets))
 
     def quality_baseline(self):
         """Drift baseline of this resident generation (delegates to the

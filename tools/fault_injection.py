@@ -1174,7 +1174,123 @@ def scenario_online_preempt(workdir: str) -> None:
           "the same next generation (%s)" % (EXIT_PREEMPTED, ref_g3))
 
 
+# ---- round 18: doctored kernel-plan cache -> analytic fallback, bit-exact ----
+
+_PLAN_CHILD_SRC = r"""
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# engage the fused Pallas path in interpret mode so the plan's bucket
+# ladder actually drives the split dispatch (CPU-only box)
+os.environ["LIGHTGBM_TPU_PALLAS_INTERPRET"] = "1"
+
+from lightgbm_tpu.utils.log import Log
+warns = {"plan": 0}
+orig_warning = Log.warning
+def counting_warning(msg, *a):
+    if "plan cache" in str(msg):
+        warns["plan"] += 1
+    orig_warning(msg, *a)
+Log.warning = staticmethod(counting_warning)
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.plan import cache as plan_cache
+from lightgbm_tpu.plan import state as plan_state
+
+n = 4096
+rng = np.random.RandomState(7)
+X = rng.normal(size=(n, 8))
+y = X[:, 0] * 1.5 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=n)
+# the cache is engaged through the DEFAULT discovery location
+# (LIGHTGBM_TPU_CACHE_DIR/plan_cache.json, set by the parent) — the
+# params stay byte-identical across runs, so the saved model files can
+# be compared whole
+params = dict(objective="regression", num_leaves=8, num_iterations=2,
+              min_data_in_leaf=2, max_bin=16, verbosity=-1)
+booster = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=2)
+booster.save_model(os.environ["MODEL_OUT"])
+gbdt = booster._booster
+print("BUCKET_PLAN=%r" % (gbdt.learner.bucket_plan,))
+print("PROVENANCE=%s" % (gbdt.learner.plan.provenance
+                         if gbdt.learner.plan is not None else None))
+if os.environ.get("LIGHTGBM_TPU_CACHE_DIR"):
+    # a second engagement of the same bad cache must count again but
+    # NEVER warn again (the ONE-warning contract is process-wide)
+    plan_state.configure(None)
+print("FALLBACKS=%d WARNINGS=%d" % (plan_cache.fallback_count(),
+                                    warns["plan"]))
+print("TRAINED-TO-END")
+"""
+
+
+def scenario_plan_cache(workdir: str) -> None:
+    """Doctored plan cache -> analytic fallback -> bit-exact completion.
+
+    Three runs of the same fused-interpret training: (A) no cache — the
+    analytic reference; (B) a VALID tuned cache whose ladder differs from
+    analytic — must engage (bucket_plan installed, provenance tuned) and
+    produce a byte-identical model (plans change dispatch only, never
+    numerics); (C) a CORRUPT cache — must fall back to analytic with the
+    counter bumped, warn exactly ONCE across two engagements, and again
+    complete byte-identical."""
+    from lightgbm_tpu.plan import cache as plan_cache
+    from lightgbm_tpu.plan import planner
+
+    def run(tag, cache_dir):
+        out = os.path.join(workdir, "plan_model_%s.txt" % tag)
+        env = {"MODEL_OUT": out}
+        if cache_dir:
+            env["LIGHTGBM_TPU_CACHE_DIR"] = cache_dir
+        p = _run_child(_PLAN_CHILD_SRC, env)
+        assert "TRAINED-TO-END" in p.stdout, p.stdout + p.stderr
+        return out, p.stdout
+
+    # (A) analytic reference
+    out_a, log_a = run("analytic", None)
+    assert "PROVENANCE=analytic" in log_a and "FALLBACKS=0" in log_a, log_a
+
+    # (B) valid tuned cache: the one-size large-pipeline ladder — a real,
+    # bit-exact-by-construction alternative to the analytic small+mid plan
+    # max_bin=16 -> the learner's store is nibble-packed: the shape class
+    # must carry packed=True or the tuned entry misses
+    sc = planner.shape_class(4096, 8, 32, packed=True, device_kind="cpu")
+    tuned_sched = ((False, 4096, None),)
+    tuned = planner.analytic_plan(sc)._replace(
+        bucket_plan=tuned_sched, level_ladder=tuned_sched,
+        provenance="tuned")
+    cache = plan_cache.PlanCache(device_kind="cpu")
+    cache.put(sc, tuned)
+    tuned_dir = os.path.join(workdir, "cache_tuned")
+    os.makedirs(tuned_dir, exist_ok=True)
+    cache.save(os.path.join(tuned_dir, "plan_cache.json"))
+    out_b, log_b = run("tuned", tuned_dir)
+    assert "PROVENANCE=tuned" in log_b, log_b
+    assert "BUCKET_PLAN=((False, 4096, None),)" in log_b, log_b
+    assert "FALLBACKS=0" in log_b and "WARNINGS=0" in log_b, log_b
+    with open(out_a, "rb") as fa, open(out_b, "rb") as fb:
+        assert fa.read() == fb.read(), \
+            "tuned plan changed the model (must be bit-exact)"
+
+    # (C) corrupt cache: fallback counted on BOTH engagements, ONE warning
+    corrupt_dir = os.path.join(workdir, "cache_corrupt")
+    os.makedirs(corrupt_dir, exist_ok=True)
+    with open(os.path.join(corrupt_dir, "plan_cache.json"), "wb") as fh:
+        fh.write(b'{"version": 1, "entries": not json at all')
+    out_c, log_c = run("corrupt", corrupt_dir)
+    assert "PROVENANCE=analytic" in log_c, log_c
+    assert "BUCKET_PLAN=None" in log_c, log_c
+    assert "FALLBACKS=2 WARNINGS=1" in log_c, log_c
+    with open(out_a, "rb") as fa, open(out_c, "rb") as fc:
+        assert fa.read() == fc.read(), \
+            "corrupt-cache fallback changed the model (must be bit-exact)"
+    print("PASS plan-cache: tuned cache engaged bit-exact; corrupt cache "
+          "fell back to analytic plans (counted twice, warned once) and "
+          "the run completed bit-exact")
+
+
 SCENARIOS = {"kill-write": scenario_kill_write,
+             "plan-cache": scenario_plan_cache,
              "online-preempt": scenario_online_preempt,
              "stall-capture": scenario_stall_capture,
              "swap-under-load": scenario_swap_under_load,

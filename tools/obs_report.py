@@ -168,6 +168,11 @@ def summary_from_events(events):
     alert_rules = {}
     alerts_fired = 0
     captures = []
+    # kernel-plan recovery (round 18): kind="plan" stamps rebuild the
+    # provenance-per-site table, kind="plan_fallback" the cache
+    # degradation count a died run never summarized
+    plan_sites = {}
+    plan_fallbacks = 0
     # online-learning recovery: kind="online_cycle" events rebuild the
     # cycles-by-trigger table and the last generation/rows_behind gauges
     # a died train-while-serve run never summarized
@@ -223,6 +228,12 @@ def summary_from_events(events):
             captures.append({k: e.get(k) for k in
                              ("n", "reason", "dir", "seconds", "error")
                              if e.get(k) is not None})
+        if e["kind"] == "plan":
+            plan_sites[str(e.get("site", "?"))] = {
+                "provenance": e.get("provenance"),
+                "key": e.get("key") or None}
+        if e["kind"] == "plan_fallback":
+            plan_fallbacks += 1
         if e["kind"] == "online_cycle":
             onl_counters["online_cycles"] = \
                 onl_counters.get("online_cycles", 0) + 1
@@ -347,12 +358,24 @@ def summary_from_events(events):
             "series": [{"rule": r, "state": info.get("last_state"), **info}
                        for r, info in sorted(alert_rules.items())],
         }
+    plan_block = None
+    if plan_sites or plan_fallbacks:
+        provs = {i.get("provenance") for i in plan_sites.values()}
+        plan_block = {
+            "provenance": ("pinned" if "pinned" in provs
+                           else "tuned" if "tuned" in provs
+                           else "analytic"),
+            "sites": plan_sites,
+            "cache_fallbacks": plan_fallbacks,
+            "recovered": True,
+        }
     return {
         **({"serving": serving} if serving else {}),
         **({"quality": quality} if quality else {}),
         **({"online": online} if online else {}),
         **({"compile": compile_block} if compile_block else {}),
         **({"alerts": alerts_block} if alerts_block else {}),
+        **({"plan": plan_block} if plan_block else {}),
         **({"profiling": {"captures": captures, "recovered": True}}
            if captures else {}),
         "resilience": resilience,

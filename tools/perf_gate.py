@@ -88,6 +88,8 @@ def sniff(doc: dict) -> str:
         return "bench_wrapper"
     if doc.get("metric") == "telemetry_run":
         return "summary"
+    if doc.get("metric") == "plan_autotune":
+        return "autotune"
     if "grid" in doc and "dropped" in doc:
         return "serve"
     if "level" in doc or ("points" in doc and "fits" in doc):
@@ -171,6 +173,36 @@ def gate_split_cost(g: Gate, path: str, doc: dict, b: dict) -> None:
                 "%.2fx >= %.2fx" % (float(amort), float(bar)))
 
 
+def gate_autotune(g: Gate, path: str, doc: dict, b: dict) -> None:
+    """BENCH_autotune artifacts (round 18): every tuned shape raced a
+    real field of candidates, produced a winner, and the winner never
+    LOST to the analytic incumbent (margin >= the declared floor — the
+    tuner may tie analytic, i.e. pick it, but a cache that persists a
+    slower-than-analytic plan is a regression by construction)."""
+    shapes = doc.get("shapes") or []
+    g.check(path, "autotune shapes present", len(shapes) >= 1,
+            "shapes=%d" % len(shapes))
+    min_cands = int(b.get("plan_autotune_min_candidates", 2))
+    margin_min = float(b.get("plan_autotune_margin_min", 1.0))
+    for res in shapes:
+        key = res.get("key", "?")
+        cands = res.get("candidates") or []
+        g.check(path, "candidates raced [%s]" % key,
+                len(cands) >= min_cands,
+                "%d >= %d" % (len(cands), min_cands))
+        win = res.get("winner") or {}
+        plan = win.get("plan") or {}
+        g.check(path, "winner persisted [%s]" % key,
+                bool(plan) and plan.get("provenance") == "tuned",
+                "winner=%s provenance=%s" % (win.get("name"),
+                                             plan.get("provenance")))
+        for metric, m in sorted((res.get("margin") or {}).items()):
+            g.check(path, "winner margin %s [%s]" % (metric, key),
+                    float(m) >= margin_min,
+                    "%.3fx >= %.2fx (analytic/winner steady p50)"
+                    % (float(m), margin_min))
+
+
 def gate_bench_line(g: Gate, path: str, doc: dict, b: dict) -> None:
     if "recompiles_steady" in doc:
         g.check(path, "recompiles steady",
@@ -243,6 +275,25 @@ def gate_summary(g: Gate, path: str, doc: dict, b: dict,
     # baseline (a run recorded WITH warmup compiles in frame); the
     # ns/row baseline above stays reserved for a steady-state BENCH
     # artifact — the two are different regimes by construction
+    # kernel-plan provenance (round 18): a summary carrying a plan block
+    # must name a known provenance for every stamped site — a BENCH
+    # number whose plan cannot be identified is not reproducible — and a
+    # steady-state baseline may not have absorbed plan-cache fallbacks.
+    # Summaries from before the planner (no block) pass untouched.
+    plan = doc.get("plan")
+    if plan is not None:
+        sites = plan.get("sites") or {}
+        known = ("analytic", "tuned", "pinned")
+        ok = bool(sites) and all(i.get("provenance") in known
+                                 for i in sites.values())
+        g.check(path, "plan provenance", ok,
+                "%s over sites %s" % (plan.get("provenance"),
+                                      sorted(sites) or "none"))
+        if plan.get("cache_fallbacks") is not None:
+            fb_max = int(b.get("plan_cache_fallbacks_max", 0))
+            g.check(path, "plan cache fallbacks",
+                    int(plan["cache_fallbacks"]) <= fb_max,
+                    "%s <= %d" % (plan["cache_fallbacks"], fb_max))
     cfac = b.get("compile_seconds_regression")
     ccur = (doc.get("compile") or {}).get("compile_seconds_total")
     cmp_base = forensics_baseline or baseline_summary
@@ -301,6 +352,8 @@ def run_gate(artifacts, budgets_path: str) -> int:
         elif kind == "summary":
             gate_summary(g, path, doc, b, tele_baseline,
                          forensics_baseline=forensics_baseline)
+        elif kind == "autotune":
+            gate_autotune(g, path, doc, b)
         elif kind == "bench_line":
             gate_bench_line(g, path, doc, b)
         else:
