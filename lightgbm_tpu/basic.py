@@ -488,7 +488,10 @@ class Booster:
         if pred_leaf:
             return self._booster.predict_leaf_index(mat, num_iteration)
         if pred_contrib:
-            return self._booster.predict_contrib(mat, num_iteration)
+            # device path-decomposition SHAP (core/predict_contrib.py);
+            # iteration subsets ride the same (start, num) range as scores
+            return self._booster.predict_contrib(
+                mat, num_iteration, start_iteration=start_iteration)
         return self._booster.predict(mat, raw_score=raw_score,
                                      num_iteration=num_iteration,
                                      start_iteration=start_iteration)

@@ -2079,18 +2079,91 @@ class GBDT:
             raw = np.asarray(self.objective.convert_output(raw))
         return raw[0] if self.num_tree_per_iteration == 1 else raw.T
 
-    def predict_contrib(self, X: np.ndarray,
-                        num_iteration: int = -1) -> np.ndarray:
-        """SHAP feature contributions (tree.h:133 PredictContrib); implemented
-        with Tree.predict_contrib once available."""
+    # below this row count the host TreeSHAP recursion wins (the device
+    # contrib program's compile is not amortized by a one-off tiny batch)
+    _DEVICE_CONTRIB_MIN_ROWS = 8
+
+    def predict_contrib(self, X: np.ndarray, num_iteration: int = -1,
+                        start_iteration: int = 0) -> np.ndarray:
+        """SHAP feature contributions (tree.h:133 PredictContrib), [N,
+        num_features+1] (last column = expected value; K classes
+        concatenate along axis 1).
+
+        Batches route through the device path-decomposition kernel
+        (core/predict_contrib.py) on f32-cast features — the same cast
+        every serving path applies — with the host per-tree TreeSHAP scan
+        as the degraded fallback (counted via ``resilience.note_fallback``
+        site ``predict_contrib``, like the round-11 predictor fallback)
+        and for small one-off batches."""
         K = self.num_tree_per_iteration
         total_iter = len(self.models) // K
-        end = total_iter if num_iteration <= 0 else min(total_iter, num_iteration)
+        end = total_iter if num_iteration <= 0 else min(
+            total_iter, start_iteration + num_iteration)
+        sel = self.models[start_iteration * K:end * K]
         n = len(X)
         ncol = self.max_feature_idx + 2
         out = np.zeros((K, n, ncol), dtype=np.float64)
-        for i in range(end * K):
-            out[i % K] += self.models[i].predict_contrib(X, ncol)
+        if sel and n >= self._DEVICE_CONTRIB_MIN_ROWS:
+            try:
+                Xf = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+                sharded = self._sharded_predict_eligible()
+                for k in range(K):
+                    pred = self._fused_predictor(sel[k::K], start_iteration,
+                                                 end, k)
+                    if sharded:
+                        from ..parallel.learners import \
+                            sharded_predict_contrib
+                        out[k] = sharded_predict_contrib(
+                            pred.contrib_blocks(ncol), Xf, ncol,
+                            self.mesh)
+                    else:
+                        out[k] = pred.predict_contrib(Xf, ncol)
+                return out[0] if K == 1 else np.concatenate(out, axis=1)
+            except Exception as exc:  # degraded: the host scan serves
+                from ..resilience import note_fallback
+                note_fallback("predict_contrib",
+                              reason="%s: %s" % (type(exc).__name__, exc),
+                              rows=int(n))
+                tele = _telemetry_active()
+                if tele is not None:
+                    # keep the live contrib_fallbacks tally consistent
+                    # with the event-stream recovery (obs_report counts
+                    # contrib-site predict_fallback breadcrumbs)
+                    tele.counter("contrib_fallbacks").inc()
+                Log.warning("device pred_contrib failed (%s: %s); serving "
+                            "DEGRADED via the host TreeSHAP scan",
+                            type(exc).__name__, exc)
+                out[:] = 0.0
+        # host scan: f32-cast rows so routing matches the device path
+        Xh = np.asarray(X, dtype=np.float32)
+        for i, tree in enumerate(sel):
+            out[i % K] += tree.predict_contrib(Xh, ncol)
+        return out[0] if K == 1 else np.concatenate(out, axis=1)
+
+    def predict_contrib_binned(self, dataset: Optional[BinnedDataset] = None,
+                               num_iteration: int = -1,
+                               start_iteration: int = 0) -> np.ndarray:
+        """SHAP contributions straight from a binned dataset's u8/u16 row
+        store — integer threshold compares with the exact ``_route_left``
+        semantics (EFB unfold, categorical bin-bitsets, missing routing),
+        pinned bitwise identical to the raw-path kernel on training
+        data."""
+        ds = dataset if dataset is not None else self.train_data
+        if ds is None or ds.binned is None:
+            raise ValueError("binned prediction needs a BinnedDataset with "
+                             "its row store attached")
+        K = self.num_tree_per_iteration
+        total_iter = len(self.models) // K
+        end = total_iter if num_iteration <= 0 else min(
+            total_iter, start_iteration + num_iteration)
+        sel = self.models[start_iteration * K:end * K]
+        ncol = self.max_feature_idx + 2
+        out = np.zeros((K, ds.num_data, ncol), dtype=np.float64)
+        layout = self.train_data if self.train_data is not None else ds
+        for k in range(K):
+            pred = self._fused_predictor(sel[k::K], start_iteration, end,
+                                         k, kind="binned", layout_ds=layout)
+            out[k] = pred.predict_contrib(ds.binned, ncol)
         return out[0] if K == 1 else np.concatenate(out, axis=1)
 
     def predict_leaf_index(self, X: np.ndarray,

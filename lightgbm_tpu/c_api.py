@@ -250,7 +250,10 @@ def _predict_matrix(cb: _CBooster, mat: np.ndarray, predict_type: int,
             out = cb.booster.predict(mat, num_iteration=num_iteration,
                                      pred_leaf=True, **kwargs)
         elif predict_type == PREDICT_CONTRIB:
-            kwargs.pop("start_iteration", None)
+            # routed through the device path-decomposition kernel (round
+            # 19) with the host TreeSHAP scan as the counted degraded
+            # fallback (resilience.note_fallback site "predict_contrib");
+            # start_iteration subsets are supported like the score path
             out = cb.booster.predict(mat, num_iteration=num_iteration,
                                      pred_contrib=True, **kwargs)
         elif predict_type == PREDICT_RAW_SCORE:

@@ -309,16 +309,25 @@ class Application:
         (``telemetry_out=...``) carries the serving SLO block.  Output is
         bit-identical to ``task=predict`` whenever predict takes the fused
         device path (>= 512 rows); below that predict's host small-batch
-        path accumulates in f64, so scores agree to f32-rounding only."""
+        path accumulates in f64, so scores agree to f32-rounding only.
+        ``predict_contrib=true`` serves SHAP contributions instead: each
+        request rides the scheduler with the per-request ``pred_contrib``
+        knob (round 19), so explanations ship through the same
+        continuous-batching ladder as scores."""
         import time
         cfg = self.config
         if not cfg.input_model:
             Log.fatal("Need input_model for serve task")
-        if cfg.predict_leaf_index or cfg.predict_contrib:
-            # the serving tier scores only; silently writing a different
-            # output format than task=predict would be a data corruption
-            Log.fatal("task=serve serves scores; predict_leaf_index/"
-                      "predict_contrib are not supported — use task=predict")
+        if cfg.predict_leaf_index:
+            # leaf indices are a different output format the serving tier
+            # does not produce; silently writing scores instead would be
+            # a data corruption.  (predict_contrib IS served: it rides
+            # the scheduler as a per-request knob below.)
+            Log.fatal("task=serve serves scores and pred_contrib; "
+                      "predict_leaf_index is not supported — use "
+                      "task=predict (or predict_leaf_index_binned via the "
+                      "Python API for binned routing)")
+        contrib = bool(cfg.predict_contrib)
         tele = self._configure_telemetry()
         preempt, own_wd = self._arm_resilience()
         t_start = time.perf_counter()
@@ -339,7 +348,7 @@ class Application:
                 futures = [server.submit(
                     "model", X[lo:lo + step],
                     raw_score=bool(cfg.predict_raw_score),
-                    num_iteration=num_iter)
+                    num_iteration=num_iter, pred_contrib=contrib)
                     for lo in range(0, len(X), step)]
                 outs = [f.result() for f in futures]
             finally:

@@ -41,6 +41,7 @@ import time
 from typing import List, NamedTuple, Optional
 
 import jax
+import jax.experimental  # noqa: F401  (enable_x64 for the contrib path)
 import jax.numpy as jnp
 import numpy as np
 
@@ -226,8 +227,9 @@ def _decide(rows: jax.Array, blk) -> jax.Array:
 
 def _block(ens, g: int):
     """[T, ...] stacked numpy arrays -> [T/G, G, ...] blocks (pad trees are
-    dead: all-zero path columns + path_len -1 never match, leaf values 0)."""
-    t = ens.path_len.shape[0]
+    dead: all-zero path columns + path_len -1 never match, leaf values 0;
+    the contrib schedule's pad trees are inactive-by-construction)."""
+    t = ens[0].shape[0]
     tb = -(-t // g)
     pad = tb * g - t
 
@@ -346,6 +348,18 @@ class FusedPredictor:
                              "layout (bin mappers + EFB groups)")
         self.kind = kind
         self.n_trees = len(trees)
+        # host trees retained for the contrib path: the SHAP schedule is
+        # harvested lazily on the first predict_contrib call (score-only
+        # serving pays nothing), and the host trees are the harvest input
+        self._trees = list(trees)
+        # lazily-built contrib program inputs per phi width, plus the g=1
+        # degraded re-blocking (same discipline as _fb_ens)
+        self._contrib: dict = {}
+        self._fb_contrib: dict = {}
+        self._contrib_warned = False
+        # optional growth hook (serving registry residency accounting):
+        # called with the byte size of lazily-built contrib ensembles
+        self.on_grow = None
         # serving attribution: the ModelRegistry stamps the owning model's
         # name here so degraded-path fallbacks count per model, and hooks
         # on_fallback so each registry tallies only its OWN degradations
@@ -451,6 +465,170 @@ class FusedPredictor:
             else:
                 scores[lo:lo + nc] = np.asarray(out[:nc], dtype=np.float64)
         return leaves if want_leaf else scores
+
+    # ---- SHAP contributions (core/predict_contrib.py) ----
+
+    def contrib_blocks(self, ncol: int):
+        """The stacked contrib program inputs for this predictor's trees
+        (decide arrays + harvested TreeSHAP schedules, [T/G', G', ...]
+        blocked at the contrib G'), built ONCE per phi width and cached —
+        the FusedPredictor cache contract extended to explanations."""
+        blocks = self._contrib.get(int(ncol))
+        if blocks is None:
+            from .predict_contrib import stack_contrib_blocked
+            blocks, g = stack_contrib_blocked(
+                self._trees, int(ncol),
+                dataset=self.layout_ds if self.kind == "binned" else None,
+                kind=self.kind)
+            self._contrib[int(ncol)] = blocks
+            if self.on_grow is not None:
+                grew = sum(int(a.size * a.dtype.itemsize)
+                           for part in blocks for a in part)
+                self.on_grow(grew)
+            tele = _telemetry_active()
+            if tele is not None:
+                # plan provenance: the contrib G is a round-18 plan site
+                # of its own (sized on the REAL schedule footprint)
+                _plan_state.stamp(
+                    tele, "contrib_fused", _plan_state.current_provenance(),
+                    key="t%d_g%d" % (self.n_trees, int(g)),
+                    store=self.kind, g=int(g))
+        return blocks
+
+    def predict_contrib(self, X, ncol: int) -> np.ndarray:
+        """[N, ncol] f64 SHAP contributions (last column = expected
+        value) through the device path-decomposition kernel.  Rows pad to
+        the same shape-bucket ladder as scores; batches beyond the top
+        bucket stream through it in fixed-shape chunks; failures serve
+        DEGRADED through the g=1 contrib program, and a failure of the
+        harvest or of the degraded program itself falls all the way back
+        to the host TreeSHAP scan (raw rows; counted — a raw contrib
+        request is never an exception)."""
+        n = len(X)
+        if self.n_trees == 0 or n == 0:
+            return np.zeros((n, int(ncol)), dtype=np.float64)
+        X = self._prep_rows(X)
+        try:
+            return self._predict_contrib_device(X, ncol)
+        except Exception as exc:  # harvest or double-failure: host net
+            return self._contrib_host_scan(X, ncol, exc)
+
+    def _predict_contrib_device(self, X: np.ndarray,
+                                ncol: int) -> np.ndarray:
+        n = len(X)
+        blocks = self.contrib_blocks(ncol)
+        top = PREDICT_BUCKETS[-1]
+        out = np.empty((n, int(ncol)), dtype=np.float64)
+        tele = _telemetry_active()
+        for lo in range(0, n, top):
+            chunk = X[lo:lo + top]
+            nc = len(chunk)
+            bucket = shape_bucket(nc)
+            if bucket > nc:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((bucket - nc,) + chunk.shape[1:],
+                                     dtype=chunk.dtype)])
+            t0 = time.perf_counter()
+            misses = 0
+            try:
+                from .predict_contrib import (contrib_compile_count,
+                                              predict_contrib_blocked)
+                with FunctionTimer("Predict::Contrib(dispatch)"), \
+                        _annotate("contrib_fused"), \
+                        jax.experimental.enable_x64():
+                    # materialize INSIDE the x64 scope: slicing the f64
+                    # result outside it would re-canonicalize avals to f32
+                    res = np.asarray(predict_contrib_blocked(
+                        blocks, jnp.asarray(chunk)))
+                misses = _recompile.note_dispatch(
+                    "predict_contrib_blocked", bucket,
+                    contrib_compile_count())
+            except Exception as exc:  # degraded serving: never an exception
+                res = self._contrib_degraded(chunk, bucket, exc, ncol)
+            if tele is not None:
+                dt = time.perf_counter() - t0
+                tele.histogram("contrib_latency_s_bucket_%d"
+                               % bucket).observe(dt)
+                tele.counter("contrib_calls").inc()
+                tele.counter("contrib_rows").inc(int(nc))
+                tele.event("contrib", rows=int(nc), bucket=int(bucket),
+                           store=self.kind, trees=int(self.n_trees),
+                           dt_s=dt)
+                _compile.note_dispatch(tele, "predict_contrib_blocked",
+                                       bucket, dt, misses)
+            out[lo:lo + nc] = np.asarray(res[:nc], dtype=np.float64)
+        return out
+
+    def _contrib_degraded(self, chunk, bucket: int, exc: Exception,
+                          ncol: int):
+        """Serve the contrib chunk through the g=1 contrib program after
+        the blocked dispatch failed — counted like every degraded path
+        (``resilience.note_fallback`` + the ``contrib_fallbacks``
+        counter), warned once per predictor."""
+        from ..resilience import note_fallback
+        from ..utils.log import Log
+        from .predict_contrib import predict_contrib_scan_fallback
+        if not self._contrib_warned:
+            self._contrib_warned = True
+            Log.warning("fused pred_contrib failed for bucket %d (%s: %s); "
+                        "serving DEGRADED via the g=1 contrib program",
+                        bucket, type(exc).__name__, exc)
+        site = ("predict_contrib_blocked@%s" % self.owner if self.owner
+                else "predict_contrib_blocked")
+        note_fallback(site, reason="%s: %s" % (type(exc).__name__, exc),
+                      bucket=int(bucket),
+                      **({"model": self.owner} if self.owner else {}))
+        tele = _telemetry_active()
+        if tele is not None:
+            tele.counter("contrib_fallbacks").inc()
+        if self.on_fallback is not None:
+            self.on_fallback(site)
+        fb = self._fb_contrib.get(int(ncol))
+        if fb is None:
+            with jax.experimental.enable_x64():
+                fb = tuple(
+                    type(part)(*[
+                        jnp.reshape(a, (a.shape[0] * a.shape[1], 1)
+                                    + a.shape[2:]) for a in part])
+                    for part in self._contrib[int(ncol)])
+            self._fb_contrib[int(ncol)] = fb
+        with jax.experimental.enable_x64():
+            res = np.asarray(predict_contrib_scan_fallback(
+                fb, jnp.asarray(chunk)))
+        _recompile.note_dispatch(
+            "predict_contrib_fallback", bucket,
+            predict_contrib_scan_fallback._cache_size())
+        return res
+
+    def _contrib_host_scan(self, X: np.ndarray, ncol: int,
+                           exc: Exception) -> np.ndarray:
+        """The last-resort net under :meth:`predict_contrib`: the host
+        per-tree TreeSHAP recursion on the f32-cast raw rows (routing
+        matches the device decide by the floored-threshold contract).
+        Binned rows carry bin CODES, not feature values — the host scan
+        cannot route them, so a binned double-failure re-raises (the
+        caller's raw-path booster fallback still applies)."""
+        if self.kind != "raw":
+            raise exc
+        from ..resilience import note_fallback
+        from ..utils.log import Log
+        site = ("predict_contrib@%s" % self.owner if self.owner
+                else "predict_contrib")
+        Log.warning("device pred_contrib failed beyond the degraded "
+                    "program (%s: %s); serving via the host TreeSHAP scan",
+                    type(exc).__name__, exc)
+        note_fallback(site, reason="%s: %s" % (type(exc).__name__, exc),
+                      rows=int(len(X)),
+                      **({"model": self.owner} if self.owner else {}))
+        tele = _telemetry_active()
+        if tele is not None:
+            tele.counter("contrib_fallbacks").inc()
+        if self.on_fallback is not None:
+            self.on_fallback(site)
+        out = np.zeros((len(X), int(ncol)), dtype=np.float64)
+        for tree in self._trees:
+            out += tree.predict_contrib(X, int(ncol))
+        return out
 
     # ---- degraded mode (resilience): per-tree scan fallback ----
 

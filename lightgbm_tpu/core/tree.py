@@ -286,12 +286,23 @@ class Tree:
                      else self.internal_count[node])
 
     def predict_contrib_row(self, x: np.ndarray, phi: np.ndarray) -> None:
-        """Add this tree's SHAP values for one row into phi [num_features+1]."""
+        """Add this tree's SHAP values for one row into phi [num_features+1].
+
+        Accumulation order is CANONICAL: expected value first, then leaves
+        in index order, then path positions in order.  Each leaf's weights
+        are bit-identical to the plain hot-first recursion (a leaf's ops
+        depend only on its own root path); only the f64 add order into phi
+        is fixed — which is what lets the device path-decomposition kernel
+        (core/predict_contrib.py) replay it bit-exactly, where the old
+        row-dependent DFS order could not be reproduced."""
         phi[-1] += self.expected_value()
         if self.num_leaves == 1:
             return
-        path = []  # list of [feature_index, zero_fraction, one_fraction, pweight]
-        self._shap_recurse(x, phi, 0, path, 1.0, 1.0, -1)
+        per_leaf = [[] for _ in range(self.num_leaves)]
+        self._shap_recurse(x, per_leaf, 0, [], 1.0, 1.0, -1)
+        for terms in per_leaf:
+            for feat, val in terms:
+                phi[feat] += val
 
     @staticmethod
     def _extend_path(path, pzf, pof, pfi):
@@ -344,14 +355,15 @@ class Tree:
                 total += (path[i][3] / zfr) / ((n - i) / (n + 1))
         return total
 
-    def _shap_recurse(self, x, phi, node, parent_path, pzf, pof, pfi):
+    def _shap_recurse(self, x, per_leaf, node, parent_path, pzf, pof, pfi):
         path = self._extend_path(parent_path, pzf, pof, pfi)
         if node < 0:
             leaf = ~node
             for i in range(1, len(path)):
                 w = self._unwound_path_sum(path, i)
                 el = path[i]
-                phi[el[0]] += w * (el[2] - el[1]) * self.leaf_value[leaf]
+                per_leaf[leaf].append(
+                    (el[0], w * (el[2] - el[1]) * self.leaf_value[leaf]))
             return
         go_left = bool(self._decide(np.asarray([x[self.split_feature[node]]]),
                                     node)[0])
@@ -367,8 +379,9 @@ class Tree:
             izf = path[path_index][1]
             iof = path[path_index][2]
             path = self._unwind_path(path, path_index)
-        self._shap_recurse(x, phi, hot, path, hot_zf * izf, iof, split_f)
-        self._shap_recurse(x, phi, cold, path, cold_zf * izf, 0.0, split_f)
+        self._shap_recurse(x, per_leaf, hot, path, hot_zf * izf, iof, split_f)
+        self._shap_recurse(x, per_leaf, cold, path, cold_zf * izf, 0.0,
+                           split_f)
 
     def predict_contrib(self, X: np.ndarray, ncol: int) -> np.ndarray:
         """SHAP values [N, num_features + 1] (last column = expected value)."""

@@ -120,6 +120,36 @@ def online_block(counters: Dict[str, Any], gauges: Dict[str, Any],
     }
 
 
+_CONTRIB_LAT = "contrib_latency_s_bucket_"
+
+
+def contrib_block(counters: Dict[str, Any], gauges: Dict[str, Any],
+                  hists: Dict[str, Any]):
+    """Fold the explanations plane (round 19 ``pred_contrib``) into one
+    summary section: device contrib dispatches/rows, per-shape-bucket
+    latency histograms, serving-tier contrib request count and degraded
+    fallbacks.  None when the run never served contributions.  Shared by
+    :func:`summarize` and ``tools/obs_report.py``'s died-run recovery."""
+    calls = int(counters.get("contrib_calls", 0))
+    reqs = int(counters.get("serve_contrib_requests", 0))
+    fbs = int(counters.get("contrib_fallbacks", 0))
+    if not calls and not reqs and not fbs:
+        # fallbacks alone still get a block: a run whose EVERY contrib
+        # call degraded at the booster level (calls==0) is exactly when
+        # the fallbacks signal matters most
+        return None
+    del gauges  # symmetry with the sibling *_block helpers
+    return {
+        "calls": calls,
+        "rows": int(counters.get("contrib_rows", 0)),
+        "serve_requests": reqs,
+        "fallbacks": fbs,
+        "latency_s": {name[len(_CONTRIB_LAT):]: h
+                      for name, h in sorted(hists.items())
+                      if name.startswith(_CONTRIB_LAT)},
+    }
+
+
 def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
               ) -> Dict[str, Any]:
     """Fold a run's registry + recompile counters into the summary dict."""
@@ -219,6 +249,12 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
     online = online_block(counters, gauges, hists)
     if online is not None:
         out["online"] = online
+    # explanations rollup (round 19, core/predict_contrib.py): contrib
+    # dispatch/row counts, per-bucket latency and degraded fallbacks —
+    # present only when the run served pred_contrib traffic
+    contrib = contrib_block(counters, gauges, hists)
+    if contrib is not None:
+        out["contrib"] = contrib
     # performance-forensics rollups (round 16), each present only when its
     # run-owned state exists: compile wall-seconds per (fn, bucket) — the
     # autotuner's ranking substrate — device-memory high-water, profiler
@@ -370,6 +406,18 @@ def human_table(summary: Dict[str, Any]) -> str:
             h = onl.get(key) or {}
             if h.get("count"):
                 row("    " + key, "n=%d p50=%.6g p99=%.6g"
+                    % (h["count"], h.get("p50", float("nan")),
+                       h.get("p99", float("nan"))))
+    ctb = summary.get("contrib") or {}
+    if ctb:
+        lines.append("  contrib:")
+        row("    calls/rows", "%d/%d (serve requests %d, fallbacks %d)"
+            % (ctb.get("calls", 0), ctb.get("rows", 0),
+               ctb.get("serve_requests", 0), ctb.get("fallbacks", 0)))
+        for bucket, h in sorted((ctb.get("latency_s") or {}).items(),
+                                key=lambda kv: int(kv[0])):
+            if h.get("count"):
+                row("    bucket %s" % bucket, "n=%d p50=%.6g p99=%.6g"
                     % (h["count"], h.get("p50", float("nan")),
                        h.get("p99", float("nan"))))
     plan = summary.get("plan") or {}

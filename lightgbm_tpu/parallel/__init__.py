@@ -8,12 +8,14 @@ from .learners import (DataParallelTreeLearner,
                        FeatureParallelTreeLearner,
                        PartitionedDataParallelTreeLearner,
                        VotingParallelTreeLearner, create_tree_learner,
-                       default_mesh, is_write_leader, sharded_predict,
+                       default_mesh, is_write_leader, sharded_contrib_fn,
+                       sharded_predict, sharded_predict_contrib,
                        sharded_predict_fn)
 
 __all__ = [
     "DataParallelTreeLearner",
     "FeatureParallelTreeLearner", "PartitionedDataParallelTreeLearner",
     "VotingParallelTreeLearner", "create_tree_learner", "default_mesh",
-    "is_write_leader", "sharded_predict", "sharded_predict_fn",
+    "is_write_leader", "sharded_contrib_fn", "sharded_predict",
+    "sharded_predict_contrib", "sharded_predict_fn",
 ]

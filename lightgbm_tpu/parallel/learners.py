@@ -195,6 +195,113 @@ def sharded_predict(ens, rows: np.ndarray, mesh: Optional[Mesh] = None, *,
     return scores
 
 
+# ---- sharded SHAP contributions (core/predict_contrib.py over the mesh) --
+
+_SHARDED_CONTRIB_FNS: dict = {}
+
+
+def sharded_contrib_fn(mesh: Mesh):
+    """Compiled sharded contrib: rows split over the mesh, the blocked
+    contrib program inputs replicated, each shard running the TreeSHAP
+    path-decomposition scan on its n/d rows; the only cross-device op is
+    the final tiled ``all_gather`` of the per-shard [n/d, C] phi rows —
+    the sharded_predict_fn discipline applied to explanations."""
+    fn = _SHARDED_CONTRIB_FNS.get(mesh)
+    if fn is None:
+        from ..core.predict_contrib import contrib_scan
+        axis = mesh.axis_names[0]
+
+        def body(blocks, rows):
+            phi = contrib_scan(blocks, rows)
+            return jax.lax.all_gather(phi, axis, tiled=True)
+
+        fn = jax.jit(_shard_map(body, mesh=mesh,
+                                in_specs=(P(), P(axis, None)),
+                                out_specs=P()))
+        _SHARDED_CONTRIB_FNS[mesh] = fn
+    return fn
+
+
+def sharded_predict_contrib(blocks, rows: np.ndarray, ncol: int,
+                            mesh: Optional[Mesh] = None) -> np.ndarray:
+    """[N, ncol] f64 SHAP contributions for ``rows`` sharded over
+    ``mesh``.  ``blocks`` is a blocked contrib input tuple from
+    ``FusedPredictor.contrib_blocks`` / ``stack_contrib_blocked``; rows
+    pad so each shard holds a fixed serving-ladder bucket, with the
+    single-device blocked program as the degraded fallback (counted)."""
+    import time as _time
+
+    import jax.experimental  # noqa: F401  (enable_x64)
+
+    from ..core.predict_fused import PREDICT_BUCKETS, shape_bucket
+    from ..obs import active as _telemetry_active
+    from ..obs import annotate as _annotate
+    from ..obs import recompile as _recompile
+    from ..resilience import note_fallback as _note_fallback
+    from ..resilience import watch as _watch
+    mesh = mesh if mesh is not None else default_mesh()
+    d = int(np.prod(mesh.devices.shape))
+    rows = np.asarray(rows)
+    if rows.dtype.kind == "f":
+        rows = rows.astype(np.float32, copy=False)
+    n = rows.shape[0]
+    fn = sharded_contrib_fn(mesh)
+    top = PREDICT_BUCKETS[-1] * d
+    out = np.empty((n, int(ncol)), dtype=np.float64)
+    tele = _telemetry_active()
+    for lo in range(0, max(n, 1), top):
+        chunk = rows[lo:lo + top]
+        nc = len(chunk)
+        bucket = shape_bucket(-(-nc // d))
+        n_pad = bucket * d
+        if n_pad > nc:
+            chunk = np.concatenate(
+                [chunk, np.zeros((n_pad - nc,) + chunk.shape[1:],
+                                 dtype=chunk.dtype)])
+        t0 = _time.perf_counter()
+        fell_back = False
+        try:
+            with _annotate("sharded_contrib"), \
+                    _watch("sharded_contrib", compile_key=int(bucket),
+                           rows=int(nc), bucket=int(bucket),
+                           shards=int(d)), \
+                    jax.experimental.enable_x64():
+                # materialize INSIDE the x64 scope (slicing f64 results
+                # outside it re-canonicalizes avals to f32)
+                res = np.asarray(fn(blocks, jnp.asarray(chunk)))
+        except Exception as exc:  # mesh unhealthy: serve single-device
+            fell_back = True
+            from ..core.predict_contrib import predict_contrib_blocked
+            from ..utils.log import Log
+            Log.warning("sharded pred_contrib failed on the %d-device mesh "
+                        "(%s: %s); serving DEGRADED on a single device",
+                        d, type(exc).__name__, exc)
+            _note_fallback("sharded_contrib", reason="%s: %s"
+                           % (type(exc).__name__, exc),
+                           bucket=int(bucket), shards=int(d))
+            with _watch("sharded_contrib_fallback", compile_key=int(bucket),
+                        rows=int(nc), bucket=int(bucket)), \
+                    jax.experimental.enable_x64():
+                res = np.asarray(predict_contrib_blocked(
+                    blocks, jnp.asarray(chunk)))
+        if not fell_back:
+            _recompile.note_dispatch(
+                "sharded_contrib", bucket, fn._cache_size(),
+                watch="sharded_contrib/%d" % id(fn))
+        if tele is not None:
+            dt = _time.perf_counter() - t0
+            tele.counter("contrib_calls").inc()
+            tele.counter("contrib_rows").inc(int(nc))
+            if fell_back:
+                tele.counter("contrib_fallbacks").inc()
+            tele.histogram("contrib_latency_s_bucket_%d"
+                           % bucket).observe(dt)
+            tele.event("contrib", rows=int(nc), bucket=int(bucket),
+                       shards=int(d), dt_s=dt, fallback=bool(fell_back))
+        out[lo:lo + nc] = np.asarray(res[:nc], dtype=np.float64)
+    return out
+
+
 class _ParallelTreeLearner(SerialTreeLearner):
     """Shared host wrapper: padding to mesh-divisible shapes + shard_map build."""
 

@@ -172,12 +172,21 @@ class ResidentModel:
             pred.owner = _safe_name(self.name)
             if self._registry is not None:
                 pred.on_fallback = self._registry._note_fallback
+            # contrib ensembles (the SHAP schedules) are built lazily on
+            # the first pred_contrib request; hook their growth into the
+            # same residency ledger so accounted-vs-actual stays honest
+            pred.on_grow = self._note_contrib_growth
             self._preds[key] = pred
             grew = _ens_bytes(pred.ens) if pred.ens is not None else 0
             self.resident_bytes += grew
             if self._registry is not None and grew:
                 self._registry._note_growth(self, grew)
         return pred
+
+    def _note_contrib_growth(self, grew: int) -> None:
+        self.resident_bytes += int(grew)
+        if self._registry is not None and grew:
+            self._registry._note_growth(self, int(grew))
 
     def _resolve_range(self, num_iteration: int,
                        start_iteration: int) -> Tuple[int, int]:
@@ -210,6 +219,19 @@ class ResidentModel:
                 round_period=int(freq))
         return self._transform(raw, raw_score)
 
+    def predict_contrib(self, rows: np.ndarray, kind: str = "raw",
+                        num_iteration: int = -1,
+                        start_iteration: int = 0) -> np.ndarray:
+        """SHAP contributions through the cached FusedPredictor(s) — the
+        device path-decomposition kernel on the same shape-bucket ladder
+        as scores, [N, (F+1)] per class concatenated along axis 1 (no
+        objective transform: contributions live in raw-score space)."""
+        start, end = self._resolve_range(num_iteration, start_iteration)
+        ncol = int(self.gbdt.max_feature_idx) + 2
+        outs = [self._predictor(kind, start, end, k).predict_contrib(
+            rows, ncol) for k in range(self.K)]
+        return outs[0] if self.K == 1 else np.concatenate(outs, axis=1)
+
     def predict_single(self, row: np.ndarray, num_iteration: int = -1,
                        start_iteration: int = 0,
                        raw_score: bool = False) -> np.ndarray:
@@ -231,14 +253,22 @@ class ResidentModel:
         raw = fn(row).reshape(self.K, 1)
         return self._transform(raw, raw_score)
 
-    def warm(self, buckets=(PREDICT_BUCKETS[0],)) -> None:
+    def warm(self, buckets=(PREDICT_BUCKETS[0],),
+             contrib: bool = False) -> None:
         """Pre-dispatch one zero batch per bucket so the first real request
         after an admission/swap never waits on a compile (a cache hit when
-        the shapes were ever compiled — the no-recompile-stall swap)."""
+        the shapes were ever compiled — the no-recompile-stall swap).
+        ``contrib=True`` additionally warms the pred_contrib programs for
+        the same buckets (a model serving explanation traffic must not
+        pay its schedule harvest + compile on the first live request)."""
         n_feat = int(self.gbdt.max_feature_idx) + 1
         for b in buckets:
             self.predict(np.zeros((int(b), n_feat), dtype=np.float32),
                          raw_score=True)
+        if contrib:
+            for b in buckets:
+                self.predict_contrib(
+                    np.zeros((int(b), n_feat), dtype=np.float32))
         # plan provenance (round 18): which planner sized the programs
         # this warmup just compiled — the serving-side half of the stamp
         # the tree builder writes at train time
@@ -427,13 +457,15 @@ class ModelRegistry:
         return entry
 
     def swap(self, name: str, booster, layout_ds=None,
-             warm=True) -> ResidentModel:
+             warm=True, warm_contrib: bool = False) -> ResidentModel:
         """Atomically republish ``name``: the replacement is fully stacked
         (and bucket-warmed unless ``warm=False``) BEFORE the flip; in-flight
         requests finish on the old ensemble, new arrivals route to the new
         one, and the old predictor entries drop when their refcount drains.
         ``warm`` may be True (smallest bucket), an iterable of bucket
-        sizes, or False."""
+        sizes, or False; ``warm_contrib`` additionally pre-compiles the
+        pred_contrib programs for the warmed buckets (models serving
+        explanation traffic across the swap)."""
         name = str(name)
         with self._lock:
             if name not in self._resident and name not in self._parked \
@@ -444,7 +476,8 @@ class ModelRegistry:
                               registry=self)
         if warm:
             entry.warm((PREDICT_BUCKETS[0],) if warm is True
-                       else tuple(int(b) for b in warm))
+                       else tuple(int(b) for b in warm),
+                       contrib=warm_contrib)
         with self._changed:
             # a racing re-admission build finishes first: the swap retires
             # whatever generation it published

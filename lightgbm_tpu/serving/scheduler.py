@@ -61,6 +61,8 @@ class _BatchKey(NamedTuple):
     margin: float
     freq: int
     raw_score: bool
+    contrib: bool        # pred_contrib: [N, F+1] SHAP output — contrib
+    #                      and score requests never share a dispatch
 
 
 class _Request:
@@ -199,9 +201,10 @@ class Server:
     def register(self, name: str, booster, layout_ds=None):
         return self.registry.register(name, booster, layout_ds=layout_ds)
 
-    def swap(self, name: str, booster, layout_ds=None, warm=True):
+    def swap(self, name: str, booster, layout_ds=None, warm=True,
+             warm_contrib: bool = False):
         return self.registry.swap(name, booster, layout_ds=layout_ds,
-                                  warm=warm)
+                                  warm=warm, warm_contrib=warm_contrib)
 
     # ---- request intake ----
 
@@ -237,11 +240,18 @@ class Server:
                raw_score: bool = False, num_iteration: int = -1,
                start_iteration: int = 0, pred_early_stop=None,
                pred_early_stop_margin=None,
-               pred_early_stop_freq=None) -> Future:
+               pred_early_stop_freq=None,
+               pred_contrib: bool = False) -> Future:
         """Enqueue one request (a single row or a micro-batch); returns a
         ``concurrent.futures.Future`` resolving to the same shape/values
         ``GBDT.predict`` (or ``predict_binned``) would produce for exactly
-        these rows."""
+        these rows.  ``pred_contrib=True`` resolves to the model's SHAP
+        contributions ([N, F+1] per class) instead of scores — the
+        per-request explanations knob: contrib requests coalesce with
+        other contrib requests on the same ladder (never with score
+        traffic — the batch key carries the flag), and the single-row
+        fast path falls back to batched dispatch (the compiled if/else
+        chain scores only)."""
         if binned:
             rows = np.ascontiguousarray(np.asarray(rows))
             if rows.dtype not in (np.uint8, np.uint16):
@@ -267,13 +277,20 @@ class Server:
         margin, freq = self._resolve_early_stop(
             name, es_defaults, es_allowed, pred_early_stop,
             pred_early_stop_margin, pred_early_stop_freq)
+        if pred_contrib:
+            # contributions live in raw-score space and accumulate every
+            # tree: early stop and the objective transform do not apply.
+            # Normalizing them out of the key keeps all contrib requests
+            # for one (model, range) in ONE batch population.
+            margin, freq, raw_score = -1.0, 10, False
         key = _BatchKey(model=str(name), kind="binned" if binned else "raw",
                         num_iteration=int(num_iteration),
                         start_iteration=int(start_iteration),
                         margin=float(margin), freq=int(freq),
-                        raw_score=bool(raw_score))
-        fast = (self.single_row_fast and not binned and len(rows) == 1
-                and margin < 0)
+                        raw_score=bool(raw_score),
+                        contrib=bool(pred_contrib))
+        fast = (self.single_row_fast and not binned and not pred_contrib
+                and len(rows) == 1 and margin < 0)
         req = _Request(key, rows, fast)
         with self._cond:
             if self._closed:
@@ -426,6 +443,10 @@ class Server:
                     start_iteration=key.start_iteration,
                     raw_score=key.raw_score)
                 self.fast_served += 1
+            elif key.contrib:
+                out = entry.predict_contrib(
+                    rows, kind=key.kind, num_iteration=key.num_iteration,
+                    start_iteration=key.start_iteration)
             else:
                 out = entry.predict(
                     rows, kind=key.kind, num_iteration=key.num_iteration,
@@ -452,6 +473,11 @@ class Server:
             tele.counter("serve_requests_model_%s" % m).inc(len(batch))
             tele.counter("serve_rows_model_%s" % m).inc(int(nrows))
             tele.counter("serve_batches").inc()
+            if key.contrib:
+                # explanations traffic accounting (the obs "contrib"
+                # summary block): requests at the scheduler grain; the
+                # predictor's own contrib_calls/rows count dispatches
+                tele.counter("serve_contrib_requests").inc(len(batch))
             if fast:
                 tele.counter("serve_single_row_fast").inc()
             bucket = 1 if fast else min(shape_bucket(nrows),
@@ -470,7 +496,8 @@ class Server:
             # understates exactly when queueing is the failure under study
             tele.event("serve_batch", model=m, requests=len(batch),
                        rows=int(nrows), bucket=int(bucket),
-                       fast=bool(fast), dt_s=done - t0,
+                       fast=bool(fast), contrib=bool(key.contrib),
+                       dt_s=done - t0,
                        lat_max_s=done - min(r.t_submit for r in batch),
                        queue_depth=int(depth))
             # per-request spans: one trace per request, with its queue
@@ -497,7 +524,8 @@ class Server:
                                        top_k=self.quality_top_k)
                 mon.observe(tele, m, entry.gbdt, entry.layout_ds,
                             entry.generation, rows, key.kind,
-                            scores=(np.asarray(out) if entry.K == 1
+                            scores=(np.asarray(out)
+                                    if entry.K == 1 and not key.contrib
                                     else None),
                             raw_score=key.raw_score)
             wall, pc = time.time(), time.perf_counter()

@@ -221,6 +221,9 @@ class LGBMModel(_LGBMModelBase):
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=None,
                 pred_leaf=False, pred_contrib=False, **kwargs):
+        """Predict scores (or, with ``pred_contrib=True``, per-feature
+        SHAP contributions [N, F+1] per class through the device
+        path-decomposition kernel — round 19)."""
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted, call fit before predict")
         return self._Booster.predict(X, raw_score=raw_score,

@@ -846,11 +846,13 @@ def test_cli_task_serve_matches_predict(tmp_path):
         summary = json.load(fh)
     assert summary["serving"]["models"]["model"]["requests"] == 600
     assert summary["rows_served"] == 600
-    # leaf/contrib output modes are a different file format: serve must
-    # refuse them loudly instead of silently writing scores
+    # leaf indices are a different output format the serving tier does
+    # not produce: serve must refuse them loudly instead of silently
+    # writing scores.  (predict_contrib IS served since round 19 — the
+    # per-request knob; tests/test_predict_contrib.py pins that path.)
     with pytest.raises(Exception, match="task=predict"):
         Application(["task=serve", "data=%s" % test,
-                     "input_model=%s" % model, "predict_contrib=true",
+                     "input_model=%s" % model, "predict_leaf_index=true",
                      "output_result=%s" % out_s, "verbosity=-1"]).run()
 
 

@@ -409,6 +409,7 @@ def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch, tmp_path):
     booster.train_chunk(8)
     booster.predict(X[:600])
     booster.predict_binned()  # the binned quality-hook path, off
+    booster.predict_contrib(X[:64])  # the contrib plane (round 19), off
     booster.train(None)  # the driver path too
     # a serving round trip (the span-instrumented scheduler) stays silent
     # too, and no listener thread exists anywhere in the process

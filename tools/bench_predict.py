@@ -65,6 +65,14 @@ def parse_args(argv=None):
     ap.add_argument("--no-proxy", action="store_true",
                     help="skip the op-count proxies (hardware runs: the "
                          "warm jitted ratio is the number)")
+    ap.add_argument("--contrib", action="store_true",
+                    help="also measure pred_contrib: host per-row TreeSHAP "
+                         "scan vs the device path-decomposition kernel "
+                         "(raw + binned), cold/warm per serving bucket")
+    ap.add_argument("--contrib-host-rows", type=int, default=64,
+                    help="rows for the host TreeSHAP reference wall (it "
+                         "is a per-row Python recursion; the per-row cost "
+                         "extrapolates)")
     ap.add_argument("--json", default="", help="write results to this path")
     return ap.parse_args(argv)
 
@@ -231,6 +239,62 @@ def main(argv=None):
     print("acceptance (%s): blocked/scan = %.3f at T=%d (bar <= 0.5: %s)"
           % (results["acceptance"]["proxy"], ratio, len(trees),
              "PASS" if ratio <= 0.5 else "FAIL"))
+
+    # ---- pred_contrib (round 19): host scan vs device kernel ----
+    if args.contrib:
+        from lightgbm_tpu.obs import recompile
+        ncol = booster.max_feature_idx + 2
+        nh = max(int(args.contrib_host_rows), 1)
+        Xh = X[:nh].astype(np.float32)
+        t0 = time.perf_counter()
+        host_phi = np.zeros((nh, ncol))
+        for t in trees:
+            host_phi += t.predict_contrib(Xh, ncol)
+        host_s = time.perf_counter() - t0
+        contrib = {"ncol": int(ncol), "host_rows": nh,
+                   "host_s": host_s, "host_s_per_row": host_s / nh,
+                   "points": []}
+        print("%9s %9s %11s %11s %13s" % ("rows", "path", "cold_ms",
+                                          "warm_ms", "rows/s(warm)"))
+        for n in sizes:
+            Xq = rows_for(n, X)
+            Bq = rows_for(n, ds.binned)
+            for name, fn in (("device", lambda Xq=Xq:
+                              fp.predict_contrib(Xq, ncol)),
+                             ("binned", lambda Bq=Bq:
+                              fpb.predict_contrib(Bq, ncol))):
+                cold, warm = timed(fn, args.reps)
+                contrib["points"].append({"rows": n, "path": name,
+                                          "cold_s": cold, "warm_s": warm})
+                print("%9d %9s %11.3f %11.3f %13.0f"
+                      % (n, "contrib:" + name, cold * 1e3, warm * 1e3,
+                         n / max(warm, 1e-12)))
+        # correctness spot-check rides the bench: the device kernel must
+        # agree with the host scan (ULP-level) and raw==binned bitwise
+        dev_phi = fp.predict_contrib(Xh, ncol)
+        ok = bool(np.allclose(dev_phi, host_phi, rtol=1e-12, atol=1e-15))
+        binned_eq = bool(np.array_equal(
+            fpb.predict_contrib(ds.binned[:nh], ncol), dev_phi))
+        base_rc = recompile.total()
+        for n in sizes:
+            fp.predict_contrib(rows_for(n, X), ncol)
+        contrib["recompiles_steady"] = recompile.total() - base_rc
+        contrib["host_agrees"] = ok
+        contrib["binned_bitwise"] = binned_eq
+        speedup = (host_s / nh) / max(
+            min(p["warm_s"] / p["rows"] for p in contrib["points"]
+                if p["path"] == "device"), 1e-12)
+        contrib["host_over_device_per_row"] = speedup
+        results["contrib"] = contrib
+        print("contrib: host %.3f ms/row vs device best %.3f ms/row "
+              "(%.0fx); host_agrees=%s binned_bitwise=%s recompiles=%d"
+              % (1e3 * host_s / nh,
+                 1e3 * min(p["warm_s"] / p["rows"]
+                           for p in contrib["points"]
+                           if p["path"] == "device"),
+                 speedup, ok, binned_eq, contrib["recompiles_steady"]))
+        if not (ok and binned_eq):
+            print("FAIL: contrib correctness spot-check", file=sys.stderr)
 
     if args.json:
         with open(args.json, "w") as fh:

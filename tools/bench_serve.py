@@ -76,6 +76,16 @@ def parse_args(argv=None):
                          "jit-cache hit and recompiles_steady stays 0)")
     ap.add_argument("--online-rounds", type=int, default=4,
                     help="boosting iterations per --online extend cycle")
+    ap.add_argument("--contrib", action="store_true",
+                    help="also sweep pred_contrib cells (round 19): the "
+                         "same open-loop windows with every request asking "
+                         "for SHAP contributions — the explanations-SLO "
+                         "cell, gated by contrib_p99_factor vs the score "
+                         "baseline")
+    ap.add_argument("--contrib-qps", default="20",
+                    help="comma list of request rates for the contrib "
+                         "cells (TreeSHAP is O(depth^2) per row — sweep "
+                         "lower rates than the score cells)")
     ap.add_argument("--warm-max-rows", type=int, default=0,
                     help="cap the warmed coalesced-batch size (0 = the "
                          "worst case, one whole window in one batch); only "
@@ -86,7 +96,19 @@ def parse_args(argv=None):
     ap.add_argument("--telemetry-out", default=None,
                     help="also record a telemetry run (JSONL + summary with "
                          "the serving SLO block)")
-    return ap.parse_args(argv)
+    args = ap.parse_args(argv)
+    # fail fast, before any model trains: contrib cells need every model
+    # that can receive contrib traffic warmed — the online publish path
+    # warms score programs only, and a --swap-mid-run replacement is a
+    # fresh model whose contrib schedules could never be pre-harvested
+    # (different tree shapes), so its first contrib dispatch would pay a
+    # harvest + compile inside a timed window
+    if args.contrib and (args.online or args.swap_mid_run):
+        ap.error("--contrib cannot combine with --online or "
+                 "--swap-mid-run (the contrib-under-swap drill lives in "
+                 "tools/fault_injection.py contrib-swap, which republishes "
+                 "a same-shape generation)")
+    return args
 
 
 def _train_model(seed, rows, features, iterations, num_leaves):
@@ -128,7 +150,8 @@ def _quantile(sorted_vals, q):
     return sorted_vals[i]
 
 
-def run_cell(server, names, pool, req_rows, qps, seconds, swap_fn=None):
+def run_cell(server, names, pool, req_rows, qps, seconds, swap_fn=None,
+             contrib=False):
     """One open-loop window; returns the latency/throughput cell dict."""
     import numpy as np
     pool = _tile_rows(pool, req_rows)
@@ -148,7 +171,7 @@ def run_cell(server, names, pool, req_rows, qps, seconds, swap_fn=None):
         lo = (i * req_rows) % max(len(pool) - req_rows, 1)
         t_sub = time.perf_counter()
         fut = server.submit(names[i % len(names)], pool[lo:lo + req_rows],
-                            raw_score=True)
+                            raw_score=True, pred_contrib=contrib)
         # completion time stamped by the dispatcher's done-callback, so the
         # collection loop below cannot inflate earlier requests' latencies
         done_at = {}
@@ -271,6 +294,22 @@ def main(argv=None):
                 # compile)
                 server.predict(name, _tile_rows(pool, r)[:r],
                                raw_score=True)
+    contrib_qps = [float(q) for q in args.contrib_qps.split(",") if q] \
+        if args.contrib else []
+    if args.contrib:
+        # warm the contrib programs for every rung the contrib windows
+        # can coalesce into, so the timed cells measure dispatch, not the
+        # schedule harvest + compile
+        c_worst = max(max(int(q * args.seconds), 1) * r
+                      for q in contrib_qps for r in rows_list)
+        c_top = shape_bucket(c_worst)
+        c_rungs = tuple(b for b in PREDICT_BUCKETS if b <= c_top) or \
+            (PREDICT_BUCKETS[0],)
+        for name in names:
+            entries[name].warm(c_rungs, contrib=True)
+            for r in sorted(set(rows_list)):
+                server.predict(name, _tile_rows(pools[name], r)[:r],
+                               pred_contrib=True)
     base_recompiles = recompile.total()
 
     swap_seq = [0]
@@ -311,6 +350,27 @@ def main(argv=None):
                      "-" if cell["achieved_qps"] is None
                      else "%.0f" % cell["achieved_qps"],
                      cell["failed"]), flush=True)
+    contrib_grid = []
+    for req_rows in rows_list:
+        for qps in contrib_qps:
+            # no mid-window swap for contrib cells: a freshly trained
+            # replacement stacks DIFFERENT schedule shapes (d/s/r maxima
+            # are per-model), so its contrib compile could never be
+            # warmed out of the timed window — the contrib-under-swap
+            # drill lives in fault_injection.py contrib-swap, which
+            # republishes a same-shape generation (the refit shape)
+            cell = run_cell(server, names, pool, req_rows, qps,
+                            args.seconds, swap_fn=None, contrib=True)
+            cell["contrib"] = True
+            contrib_grid.append(cell)
+            print("CONTRIB qps=%-6g rows=%-5d p50=%s p99=%s achieved=%s "
+                  "failed=%d"
+                  % (qps, req_rows,
+                     "-" if cell["p50_s"] is None else "%.6f" % cell["p50_s"],
+                     "-" if cell["p99_s"] is None else "%.6f" % cell["p99_s"],
+                     "-" if cell["achieved_qps"] is None
+                     else "%.0f" % cell["achieved_qps"],
+                     cell["failed"]), flush=True)
     stats = server.stats()
     online_stats = None
     if controller is not None:
@@ -341,6 +401,15 @@ def main(argv=None):
         "grid": grid,
         "device": os.environ.get("JAX_PLATFORMS", ""),
     }
+    if contrib_grid:
+        c_p99s = [c["p99_s"] for c in contrib_grid if c["p99_s"] is not None]
+        artifact["contrib"] = {
+            "qps": contrib_qps,
+            "request_rows": rows_list,
+            "value": max(c_p99s) if c_p99s else None,
+            "unit": "s",
+            "grid": contrib_grid,
+        }
     if online_stats is not None:
         artifact["online"] = {
             "cycles": online_stats["cycles"],

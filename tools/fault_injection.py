@@ -1289,7 +1289,133 @@ def scenario_plan_cache(workdir: str) -> None:
           "the run completed bit-exact")
 
 
+# ---- contrib-under-swap: explanations traffic across a hot-swap (r19) ----
+
+def scenario_contrib_swap(workdir: str) -> None:
+    """Round 19's serving drill: MIXED score + pred_contrib traffic across
+    a mid-traffic hot-swap.  The replacement is a leaf-value-perturbed
+    republish of the same ensemble (the online refit shape: identical
+    tree structure, different outputs — so score AND contrib programs are
+    pure jit-cache hits).  Asserts ZERO dropped requests, every response
+    — scores and [N, F+1] phi matrices alike — BIT-exact vs the
+    generation that served it, and ZERO steady-state recompiles after
+    warmup."""
+    import threading
+
+    import numpy as np
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+    from lightgbm_tpu.obs import recompile
+    from lightgbm_tpu.serving import Server
+
+    rng = np.random.RandomState(5)
+    X = rng.uniform(-2, 2, size=(800, 6)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2)
+         + 0.1 * rng.normal(size=800)).astype(np.float64)
+    cfg = Config(objective="regression", num_leaves=8,
+                 min_data_in_leaf=5, verbosity=-1, num_iterations=10)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
+                                   min_data_in_leaf=cfg.min_data_in_leaf)
+    bA = create_boosting(cfg.boosting, cfg, ds,
+                         create_objective(cfg.objective, cfg))
+    for _ in range(10):
+        bA.train_one_iter()
+    # the republish: the SAME structure with perturbed leaf values (the
+    # online refit shape) — contrib schedules stack to identical shapes,
+    # so the swap is a pure jit-cache hit for score AND contrib programs
+    bB = GBDT(cfg)
+    bB.load_model_from_string(bA.save_model_to_string())
+    for t in bB.models:
+        t.leaf_value = t.leaf_value * 1.1
+    ncol = bA.max_feature_idx + 2
+    sizes = (1, 17, 64)
+    # references through the SAME fused programs serving dispatches (the
+    # host small-batch / host TreeSHAP paths agree only to rounding)
+    from lightgbm_tpu.core.predict_fused import FusedPredictor
+    fpA, fpB = FusedPredictor(bA.models), FusedPredictor(bB.models)
+    refs = {
+        ("a", "score"): {n: fpA(X[:n]) for n in sizes},
+        ("b", "score"): {n: fpB(X[:n]) for n in sizes},
+        ("a", "contrib"): {n: fpA.predict_contrib(X[:n], ncol)
+                           for n in sizes},
+        ("b", "contrib"): {n: fpB.predict_contrib(X[:n], ncol)
+                           for n in sizes},
+    }
+    srv = Server(max_batch_wait_us=500)
+    srv.register("m", bA)
+    # warm every rung the mixed traffic can coalesce into, scores AND
+    # contrib (4 threads x 2-outstanding x 64 rows stays under 1024)
+    entry = srv.registry._resident["m"]
+    entry.warm((128, 1024), contrib=True)
+    for n in sizes:
+        srv.predict("m", X[:n])
+        srv.predict("m", X[:n], pred_contrib=True)
+    base = recompile.total()
+
+    results = []
+    res_lock = threading.Lock()
+
+    def traffic(tid):
+        rng_t = np.random.RandomState(100 + tid)
+        outstanding = []
+        for i in range(50):
+            n = int(sizes[rng_t.randint(len(sizes))])
+            contrib = (i + tid) % 2 == 0
+            fut = srv.submit("m", X[:n], raw_score=True,
+                             pred_contrib=contrib)
+            with res_lock:
+                results.append((n, "contrib" if contrib else "score", fut))
+            outstanding.append(fut)
+            if len(outstanding) >= 2:
+                outstanding.pop(0).result()
+
+    threads = [threading.Thread(target=traffic, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 180
+    while True:
+        with res_lock:
+            submitted = len(results)
+        if submitted >= 40:
+            break
+        assert time.time() < deadline, "traffic stalled before the swap"
+        time.sleep(0.002)
+    srv.swap("m", bB, warm=(128, 1024), warm_contrib=True)
+    for t in threads:
+        t.join()
+    srv.close()
+
+    stats = srv.stats()
+    assert stats["dropped"] == 0 and stats["failed"] == 0, stats
+    served_old = served_new = mismatches = 0
+    for n, mode, fut in results:
+        got = fut.result(timeout=60)
+        old = np.array_equal(got, refs[("a", mode)][n])
+        new = np.array_equal(got, refs[("b", mode)][n])
+        served_old += old
+        served_new += new
+        mismatches += not (old or new)
+    assert mismatches == 0, \
+        "%d responses matched neither generation" % mismatches
+    assert served_new > 0, "no request reached the swapped-in model"
+    n_contrib = sum(1 for _, m, _ in results if m == "contrib")
+    assert n_contrib > 0, "no contrib traffic generated"
+    delta = recompile.total() - base
+    assert delta == 0, ("contrib-under-swap recompiled %d times after "
+                        "warmup" % delta)
+    print("PASS contrib-swap: %d requests (%d contrib) served bit-exact "
+          "across the hot-swap (%d old / %d new generation), 0 drops, "
+          "0 steady-state recompiles" % (len(results), n_contrib,
+                                         served_old, served_new))
+
+
 SCENARIOS = {"kill-write": scenario_kill_write,
+             "contrib-swap": scenario_contrib_swap,
              "plan-cache": scenario_plan_cache,
              "online-preempt": scenario_online_preempt,
              "stall-capture": scenario_stall_capture,

@@ -179,6 +179,11 @@ def summary_from_events(events):
     onl_counters = {}
     onl_gauges = {}
     onl_hists = {}
+    # explanations recovery (round 19): kind="contrib" dispatch events +
+    # contrib-tagged serve batches rebuild the contrib block a died run
+    # never summarized
+    ctb_counters = {}
+    ctb_hists = {}
     n_events = 0
     for e in events:
         n_events += 1
@@ -248,6 +253,24 @@ def summary_from_events(events):
                 if isinstance(e.get(field), (int, float)):
                     onl_hists.setdefault(hname,
                                          Histogram()).observe(e[field])
+        if e["kind"] == "contrib":
+            ctb_counters["contrib_calls"] = \
+                ctb_counters.get("contrib_calls", 0) + 1
+            ctb_counters["contrib_rows"] = \
+                ctb_counters.get("contrib_rows", 0) + int(e.get("rows", 0))
+            if isinstance(e.get("dt_s"), (int, float)) \
+                    and e.get("bucket") is not None:
+                ctb_hists.setdefault(
+                    "contrib_latency_s_bucket_%d" % int(e["bucket"]),
+                    Histogram()).observe(e["dt_s"])
+        if e["kind"] == "predict_fallback" \
+                and "contrib" in str(e.get("site", "")):
+            ctb_counters["contrib_fallbacks"] = \
+                ctb_counters.get("contrib_fallbacks", 0) + 1
+        if e["kind"] == "serve_batch" and e.get("contrib"):
+            ctb_counters["serve_contrib_requests"] = \
+                ctb_counters.get("serve_contrib_requests", 0) \
+                + int(e.get("requests", 1))
         if e["kind"] == "serve_batch":
             m = str(e.get("model", "?"))
             for ck, n in (("serve_batches", 1),
@@ -334,9 +357,13 @@ def summary_from_events(events):
             q_models[m] = entry
     quality = ({"models": q_models, "generations": q_gens}
                if q_models else None)
-    from lightgbm_tpu.obs.report import online_block
+    from lightgbm_tpu.obs.report import contrib_block, online_block
     online = online_block(onl_counters, onl_gauges,
                           {k: h.summary() for k, h in onl_hists.items()})
+    contrib = contrib_block(ctb_counters, {},
+                            {k: h.summary() for k, h in ctb_hists.items()})
+    if contrib is not None:
+        contrib["recovered"] = True
     compile_block = None
     if compile_keys:
         compile_block = {
@@ -373,6 +400,7 @@ def summary_from_events(events):
         **({"serving": serving} if serving else {}),
         **({"quality": quality} if quality else {}),
         **({"online": online} if online else {}),
+        **({"contrib": contrib} if contrib else {}),
         **({"compile": compile_block} if compile_block else {}),
         **({"alerts": alerts_block} if alerts_block else {}),
         **({"plan": plan_block} if plan_block else {}),

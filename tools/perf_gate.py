@@ -30,7 +30,12 @@ budgets:
   section fired at most ``alerts_fired_max`` live alerts (0: a healthy
   baseline never pages), and its ``compile.compile_seconds_total`` may
   not exceed the committed telemetry baseline's by more than
-  ``compile_seconds_regression``.
+  ``compile_seconds_regression``;
+- explanations budgets (round 19) — a bench-serve artifact carrying a
+  ``contrib`` block completed every contrib window (failed == 0, and the
+  artifact-wide dropped/recompile gauges cover contrib traffic too) with
+  the worst contrib p99 within ``contrib_p99_factor`` of the same
+  artifact's score headline.
 
 Artifact type is sniffed from its keys (telemetry summary / bench-serve
 grid / split-cost / bench.py wrapper), so one invocation can gate a mixed
@@ -142,6 +147,32 @@ def gate_serve(g: Gate, path: str, doc: dict, b: dict, baseline) -> None:
                 % (worst, base, base * float(factor), float(factor)))
     elif factor:
         g.skip(path, "serve p99 regression", "no serve baseline artifact")
+    # explanations cells (round 19, bench_serve --contrib): every contrib
+    # window completed, and the worst contrib p99 stays within the
+    # declared factor of the SAME artifact's score headline — TreeSHAP is
+    # O(depth^2)/row vs O(depth) for a score, so the factor budgets the
+    # inherent cost without letting it regress silently
+    ctb = doc.get("contrib")
+    if ctb is not None:
+        cells = ctb.get("grid") or []
+        g.check(path, "contrib cells complete",
+                bool(cells) and all(int(c.get("failed", 0)) == 0
+                                    for c in cells),
+                "cells=%d failed=%s" % (len(cells),
+                                        sum(int(c.get("failed", 0))
+                                            for c in cells)))
+        cfac = b.get("contrib_p99_factor")
+        score_p99 = doc.get("value")
+        if cfac and ctb.get("value") is not None and score_p99:
+            worst_c = float(ctb["value"])
+            bar = float(score_p99) * float(cfac)
+            g.check(path, "contrib p99 vs score cells",
+                    worst_c <= bar,
+                    "contrib p99 %.4gs vs score %.4gs (bar %.4gs = %.0fx)"
+                    % (worst_c, float(score_p99), bar, float(cfac)))
+        elif cfac:
+            g.skip(path, "contrib p99 vs score cells",
+                   "no score headline to compare against")
 
 
 def gate_split_cost(g: Gate, path: str, doc: dict, b: dict) -> None:
