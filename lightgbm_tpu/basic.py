@@ -478,7 +478,7 @@ class Booster:
     def predict(self, data, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
-                **kwargs) -> np.ndarray:
+                precision: str = "exact", **kwargs) -> np.ndarray:
         if isinstance(data, Dataset):
             raise TypeError("Cannot use Dataset instance for prediction, "
                             "please use raw data instead")
@@ -486,15 +486,21 @@ class Booster:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         if pred_leaf:
+            # leaf indices are integer routing — identical under bf16, so
+            # no precision knob (nothing lossy to budget)
             return self._booster.predict_leaf_index(mat, num_iteration)
         if pred_contrib:
+            if precision != "exact":
+                raise LightGBMError("pred_contrib has no bf16 tier — "
+                                    "precision must be 'exact'")
             # device path-decomposition SHAP (core/predict_contrib.py);
             # iteration subsets ride the same (start, num) range as scores
             return self._booster.predict_contrib(
                 mat, num_iteration, start_iteration=start_iteration)
         return self._booster.predict(mat, raw_score=raw_score,
                                      num_iteration=num_iteration,
-                                     start_iteration=start_iteration)
+                                     start_iteration=start_iteration,
+                                     precision=precision)
 
     def predict_binned(self, data: Dataset, start_iteration: int = 0,
                        num_iteration: Optional[int] = None,

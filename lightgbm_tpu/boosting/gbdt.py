@@ -1987,16 +1987,19 @@ class GBDT:
         return n >= self._DEVICE_PREDICT_MIN_ROWS and len(models) > 0
 
     def _fused_predictor(self, sel: List[Tree], start: int, end: int,
-                         class_id: int, kind: str = "raw", layout_ds=None):
+                         class_id: int, kind: str = "raw", layout_ds=None,
+                         precision: str = "exact"):
         """EnsembleArrays-keyed predictor cache: the stacked blocked device
-        ensemble for one (model range, class, generation, kind) is built
-        once and reused by every subsequent predict/eval/refit call."""
+        ensemble for one (model range, class, generation, kind, precision)
+        is built once and reused by every subsequent predict/eval/refit
+        call.  The bf16 tier is its own cache entry — tiers never share a
+        stacked ensemble or a compiled program."""
         from ..core.predict_fused import FusedPredictor
         if kind == "binned" and layout_ds is None:
             layout_ds = self.train_data
         key = (kind, start, end, class_id, len(self._models),
                getattr(self, "_model_gen", 0),
-               id(layout_ds) if kind == "binned" else 0)
+               id(layout_ds) if kind == "binned" else 0, precision)
         cache = getattr(self, "_fused_pred", None)
         if cache is None:
             cache = self._fused_pred = {}
@@ -2007,7 +2010,8 @@ class GBDT:
                 # iteration; drop the oldest stacked ensembles instead of
                 # holding every generation's device arrays alive
                 cache.pop(next(iter(cache)))
-            pred = FusedPredictor(sel, dataset=layout_ds, kind=kind)
+            pred = FusedPredictor(sel, dataset=layout_ds, kind=kind,
+                                  precision=precision)
             cache[key] = pred
         return pred
 
@@ -2016,7 +2020,8 @@ class GBDT:
                 and int(np.prod(self.mesh.devices.shape)) > 1)
 
     def _raw_predict(self, X: np.ndarray, num_iteration: int = -1,
-                     start_iteration: int = 0) -> np.ndarray:
+                     start_iteration: int = 0,
+                     precision: str = "exact") -> np.ndarray:
         n = len(X)
         K = self.num_tree_per_iteration
         out = np.zeros((K, n), dtype=np.float64)
@@ -2025,11 +2030,16 @@ class GBDT:
             total_iter, start_iteration + num_iteration)
         sel = self.models[start_iteration * K:end_iter * K]
         margin, freq = self._predict_early_stop()
-        if self._use_device_predict(sel, n):
+        # a bf16 request always rides the fused device path: the host
+        # small-batch predictors are exact-only, and silently upgrading a
+        # lossy request to exact would hide the tier the caller asked for
+        if self._use_device_predict(sel, n) \
+                or (precision != "exact" and len(sel) > 0 and n > 0):
             sharded = self._sharded_predict_eligible()
             for k in range(K):
                 pred = self._fused_predictor(sel[k::K], start_iteration,
-                                             end_iter, k)
+                                             end_iter, k,
+                                             precision=precision)
                 if sharded:
                     from ..parallel.learners import sharded_predict
                     out[k] = sharded_predict(
@@ -2070,8 +2080,12 @@ class GBDT:
         return out
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
-                num_iteration: int = -1, start_iteration: int = 0) -> np.ndarray:
-        raw = self._raw_predict(X, num_iteration, start_iteration)
+                num_iteration: int = -1, start_iteration: int = 0,
+                precision: str = "exact") -> np.ndarray:
+        if precision not in ("exact", "bf16"):
+            raise ValueError("precision must be 'exact' or 'bf16'")
+        raw = self._raw_predict(X, num_iteration, start_iteration,
+                                precision=precision)
         if self.average_output:
             total_iter = max(len(self.models) // self.num_tree_per_iteration, 1)
             raw = raw / total_iter

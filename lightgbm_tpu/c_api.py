@@ -232,6 +232,15 @@ def _predict_matrix(cb: _CBooster, mat: np.ndarray, predict_type: int,
     # (config.h pred_early_stop*); scoped to this call, then restored
     early_stop = {k: params.pop(k) for k in _PRED_EARLY_STOP_KEYS
                   if k in params}
+    # lossy serving tier (round 20): "predict_precision=bf16" in the
+    # parameter string selects the budget-gated bf16 score path; leaf
+    # and contrib outputs have no lossy tier (integer routing resp.
+    # additivity contract), so the knob is rejected there rather than
+    # silently upgraded
+    precision = str(params.pop("predict_precision", "exact"))
+    if precision not in ("exact", "bf16"):
+        raise LightGBMError("predict_precision must be 'exact' or 'bf16', "
+                            "got %r" % (precision,))
     ignored = {k: v for k, v in params.items()
                if k not in ("verbosity", "predict_raw_score",
                             "predict_leaf_index", "predict_contrib")}
@@ -250,6 +259,9 @@ def _predict_matrix(cb: _CBooster, mat: np.ndarray, predict_type: int,
             out = cb.booster.predict(mat, num_iteration=num_iteration,
                                      pred_leaf=True, **kwargs)
         elif predict_type == PREDICT_CONTRIB:
+            if precision != "exact":
+                raise LightGBMError("pred_contrib has no bf16 tier — "
+                                    "predict_precision must be exact")
             # routed through the device path-decomposition kernel (round
             # 19) with the host TreeSHAP scan as the counted degraded
             # fallback (resilience.note_fallback site "predict_contrib");
@@ -258,10 +270,11 @@ def _predict_matrix(cb: _CBooster, mat: np.ndarray, predict_type: int,
                                      pred_contrib=True, **kwargs)
         elif predict_type == PREDICT_RAW_SCORE:
             out = cb.booster.predict(mat, num_iteration=num_iteration,
-                                     raw_score=True, **kwargs)
+                                     raw_score=True, precision=precision,
+                                     **kwargs)
         else:
             out = cb.booster.predict(mat, num_iteration=num_iteration,
-                                     **kwargs)
+                                     precision=precision, **kwargs)
     finally:
         if early_stop:
             cfg.set({k: (str(v).lower() if isinstance(v, bool) else str(v))

@@ -279,13 +279,23 @@ class Application:
             loader = DatasetLoader(cfg)
             X = loader.load_prediction_data(cfg.data)
             num_iter = int(cfg.num_iteration_predict)
+            precision = str(cfg.predict_precision)
             if cfg.predict_leaf_index:
+                # leaf routing is integer work with no lossy tier: indices
+                # are identical under bf16, so a precision knob here would
+                # only suggest a difference that cannot exist
                 out = booster.predict_leaf_index(X, num_iter)
             elif cfg.predict_contrib:
+                if precision != "exact":
+                    # contributions have no lossy tier (additivity is the
+                    # contract); silently upgrading would hide the knob
+                    Log.fatal("predict_contrib has no bf16 tier — "
+                              "predict_precision must be exact")
                 out = booster.predict_contrib(X, num_iter)
             else:
                 out = booster.predict(X, raw_score=bool(cfg.predict_raw_score),
-                                      num_iteration=num_iter)
+                                      num_iteration=num_iter,
+                                      precision=precision)
             self._write_result(cfg.output_result, out)
             Log.info("Finished prediction, wrote results to %s", cfg.output_result)
             if tele is not None:
@@ -328,6 +338,12 @@ class Application:
                       "task=predict (or predict_leaf_index_binned via the "
                       "Python API for binned routing)")
         contrib = bool(cfg.predict_contrib)
+        precision = str(cfg.predict_precision)
+        if contrib and precision != "exact":
+            # Server.submit rejects the combination per request; fail the
+            # whole task up front instead of after N-1 good futures
+            Log.fatal("predict_contrib has no bf16 tier — "
+                      "predict_precision must be exact")
         tele = self._configure_telemetry()
         preempt, own_wd = self._arm_resilience()
         t_start = time.perf_counter()
@@ -348,7 +364,8 @@ class Application:
                 futures = [server.submit(
                     "model", X[lo:lo + step],
                     raw_score=bool(cfg.predict_raw_score),
-                    num_iteration=num_iter, pred_contrib=contrib)
+                    num_iteration=num_iter, pred_contrib=contrib,
+                    precision=precision)
                     for lo in range(0, len(X), step)]
                 outs = [f.result() for f in futures]
             finally:

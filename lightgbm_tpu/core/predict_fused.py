@@ -73,7 +73,8 @@ PREDICT_BUCKETS = (128, 1024, 8192, 65536, 524288)
 
 
 def tree_block(t: int, m: int, l: int,
-               vmem_bytes: Optional[int] = None) -> int:
+               vmem_bytes: Optional[int] = None,
+               precision: str = "exact") -> int:
     """Trees per scan block: the largest count whose stacked [G, M, L] path
     matrices fit the block VMEM budget, rebalanced so the final block is
     not ragged (T=100 at cap 32 -> 4 blocks of 25, zero pad trees).
@@ -81,10 +82,13 @@ def tree_block(t: int, m: int, l: int,
     The budget defaults through the kernel planner (round 18): a pinned
     or tuned plan's ``predict_block_vmem_bytes`` wins, else the
     device-spec constant — byte-equal to the historical sizing when no
-    plan cache is engaged."""
+    plan cache is engaged.  The bf16 tier's path matrices are 2 bytes per
+    element, so the same VMEM budget admits ~2x the trees per block —
+    bf16 stackings get their OWN G (and their own plan site,
+    ``predict_fused_bf16``), never the exact tier's."""
     if vmem_bytes is None:
         vmem_bytes = _plan_state.predict_block_vmem() or BLOCK_VMEM_BYTES
-    per_tree = max(m * l * 4, 1)
+    per_tree = max(m * l * (2 if precision == "bf16" else 4), 1)
     cap = max(1, min(BLOCK_MAX, int(vmem_bytes) // per_tree, max(t, 1)))
     n_blocks = -(-max(t, 1) // cap)
     return -(-max(t, 1) // n_blocks)
@@ -243,21 +247,37 @@ def _block(ens, g: int):
     return type(ens)(*[one(n, a) for n, a in zip(ens._fields, ens)])
 
 
-def stack_ensemble_blocked(trees: List[Tree],
-                           g: Optional[int] = None) -> EnsembleArrays:
+def _cast_lossy(ens):
+    """The bf16 tier's device ensemble: ``path_sign`` and ``leaf_value``
+    in bfloat16, EVERY routing array untouched.  Path signs are exactly
+    ±1/0 in bf16, and the hit contraction accumulates in f32
+    (``preferred_element_type``), so leaf HITS stay bit-exact vs the exact
+    tier — only the leaf values (bf16-rounded) and the score accumulation
+    (bf16 carry) are lossy, which is the declared error the budget gates."""
+    return ens._replace(path_sign=ens.path_sign.astype(jnp.bfloat16),
+                        leaf_value=ens.leaf_value.astype(jnp.bfloat16))
+
+
+def stack_ensemble_blocked(trees: List[Tree], g: Optional[int] = None,
+                           precision: str = "exact") -> EnsembleArrays:
     """Raw-feature blocked device ensemble ([T/G, G, ...] fields)."""
     host = stack_ensemble_host(trees)
     m, l = host.path_sign.shape[1], host.path_sign.shape[2]
-    return _block(host, g or tree_block(len(trees), m, l))
+    ens = _block(host, g or tree_block(len(trees), m, l,
+                                       precision=precision))
+    return _cast_lossy(ens) if precision == "bf16" else ens
 
 
 def stack_ensemble_binned_blocked(trees: List[Tree], dataset,
-                                  g: Optional[int] = None
+                                  g: Optional[int] = None,
+                                  precision: str = "exact"
                                   ) -> BinnedEnsembleArrays:
     """Binned blocked device ensemble ([T/G, G, ...] fields)."""
     host = stack_ensemble_binned_host(trees, dataset)
     m, l = host.path_sign.shape[1], host.path_sign.shape[2]
-    return _block(host, g or tree_block(len(trees), m, l))
+    ens = _block(host, g or tree_block(len(trees), m, l,
+                                       precision=precision))
+    return _cast_lossy(ens) if precision == "bf16" else ens
 
 
 def scan_blocks(blocks, rows: jax.Array, *, early_stop_margin: float = -1.0,
@@ -268,21 +288,32 @@ def scan_blocks(blocks, rows: jax.Array, *, early_stop_margin: float = -1.0,
     [N, G, M] x [G, M, L] contraction, an exact one-hot match, then an
     unrolled per-tree accumulate that replays the per-tree scan's f32 add
     order and early-stop check positions bit-exactly (margin-based
-    prediction early stop, prediction_early_stop.cpp:26-65)."""
+    prediction early stop, prediction_early_stop.cpp:26-65).
+
+    Dtype-generic over the ensemble's value arrays: the accumulate dtype
+    is inferred from ``leaf_value`` (f32 exact tier / bf16 lossy tier),
+    and every cast below is a no-op for f32 inputs, so the exact tier's
+    jaxpr — and therefore its compiled program and its scores — is
+    byte-identical to the pre-precision-axis one.  In the bf16 tier the
+    hit sums still accumulate in f32 (small exact integers from ±1 bf16
+    products) and ``match`` is still an exact one-hot, so ROUTING is
+    bit-exact across tiers; only leaf rounding + the bf16 score carry
+    differ."""
     n = rows.shape[0]
     g = blocks.path_len.shape[1]
+    acc_dtype = blocks.leaf_value.dtype
 
     def block_step(carry, blk):
         score, active, idx = carry
         go_left = _decide(rows, blk)                        # [N, G, M]
-        d = jnp.where(go_left, 1.0, -1.0).astype(jnp.float32)
+        d = jnp.where(go_left, 1.0, -1.0).astype(blk.path_sign.dtype)
         hits = jax.lax.dot_general(
             d, blk.path_sign, (((2,), (1,)), ((1,), (0,))),
             preferred_element_type=jnp.float32)             # [G, N, L]
-        match = (hits == blk.path_len[:, None, :]).astype(jnp.float32)
+        match = (hits == blk.path_len[:, None, :]).astype(acc_dtype)
         contrib = jax.lax.dot_general(
             match, blk.leaf_value, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)             # [G, N]
+            preferred_element_type=acc_dtype)               # [G, N]
         for j in range(g):
             score = score + jnp.where(active, contrib[j], 0.0)
             if early_stop_margin >= 0:
@@ -294,7 +325,7 @@ def scan_blocks(blocks, rows: jax.Array, *, early_stop_margin: float = -1.0,
             return (score, active, idx + g), leaf
         return (score, active, idx + g), None
 
-    init = (jnp.zeros((n,), jnp.float32), jnp.ones((n,), bool), jnp.int32(0))
+    init = (jnp.zeros((n,), acc_dtype), jnp.ones((n,), bool), jnp.int32(0))
     (score, _, _), leaves = jax.lax.scan(block_step, init, blocks)
     if want_leaf:
         return score, jnp.transpose(leaves, (2, 0, 1)).reshape(n, -1)
@@ -340,13 +371,23 @@ class FusedPredictor:
     path is pad-to-bucket + one cached-executable call."""
 
     def __init__(self, trees: List[Tree], dataset=None,
-                 kind: str = "raw") -> None:
+                 kind: str = "raw", precision: str = "exact") -> None:
         if kind not in ("raw", "binned"):
             raise ValueError("kind must be 'raw' or 'binned'")
+        if precision not in ("exact", "bf16"):
+            raise ValueError("precision must be 'exact' or 'bf16'")
         if kind == "binned" and dataset is None:
             raise ValueError("binned predictor needs the training dataset "
                              "layout (bin mappers + EFB groups)")
         self.kind = kind
+        self.precision = precision
+        # recompile/compile attribution site: the bf16 tier dispatches
+        # through the SAME predict_blocked jit cache (dtype is part of the
+        # aval, so tiers can never share a compiled program), but counts
+        # under its own site name; `watch` below keys the shared cache so
+        # the first bf16 dispatch doesn't inherit exact-tier compiles
+        self._site = ("predict_blocked" if precision == "exact"
+                      else "predict_blocked_bf16")
         self.n_trees = len(trees)
         # host trees retained for the contrib path: the SHAP schedule is
         # harvested lazily on the first predict_contrib call (score-only
@@ -376,17 +417,21 @@ class FusedPredictor:
         self._fb_ens = None
         self._fb_warned = False
         if kind == "raw":
-            self.ens = stack_ensemble_blocked(trees) if trees else None
-        else:
-            self.ens = (stack_ensemble_binned_blocked(trees, dataset)
+            self.ens = (stack_ensemble_blocked(trees, precision=precision)
                         if trees else None)
+        else:
+            self.ens = (stack_ensemble_binned_blocked(
+                trees, dataset, precision=precision) if trees else None)
         # plan provenance (round 18): which planner sized this stacking's
         # tree-block G — stamped once per run so BENCH/serving artifacts
-        # record the plan behind every latency number
+        # record the plan behind every latency number.  The bf16 tier is
+        # its own site (its 2-byte path matrices size a different G).
         tele = _telemetry_active()
         if tele is not None and self.ens is not None:
             _plan_state.stamp(
-                tele, "predict_fused", _plan_state.current_provenance(),
+                tele, ("predict_fused" if precision == "exact"
+                       else "predict_fused_bf16"),
+                _plan_state.current_provenance(),
                 key="t%d_g%d" % (self.n_trees,
                                  int(self.ens.path_len.shape[1])),
                 store=self.kind, g=int(self.ens.path_len.shape[1]))
@@ -439,25 +484,31 @@ class FusedPredictor:
                 # growth of the bucketed dispatch's compiled-program count
                 # is a recompile, attributed to this row bucket: the live
                 # form of the "steady-state serving never recompiles"
-                # invariant
-                misses = _recompile.note_dispatch("predict_blocked", bucket,
-                                                  predict_compile_count())
+                # invariant.  watch= keys the SHARED predict_blocked jit
+                # cache, so each tier baselines against the same counter
+                # instead of charging the other tier's compiles to itself.
+                misses = _recompile.note_dispatch(self._site, bucket,
+                                                  predict_compile_count(),
+                                                  watch="predict_blocked")
             except Exception as exc:  # degraded serving: never an exception
                 out = self._predict_degraded(
                     jnp.asarray(chunk), bucket, exc,
                     float(early_stop_margin), int(round_period), want_leaf)
             if tele is not None:
                 dt = time.perf_counter() - t0
-                tele.histogram("predict_dispatch_s_bucket_%d"
-                               % bucket).observe(dt)
+                hist = ("predict_dispatch_s_bucket_%d" % bucket
+                        if self.precision == "exact" else
+                        "predict_dispatch_bf16_s_bucket_%d" % bucket)
+                tele.histogram(hist).observe(dt)
                 tele.event("predict", rows=int(nc), bucket=int(bucket),
                            store=self.kind, trees=int(self.n_trees),
-                           dt_s=dt, want_leaf=bool(want_leaf))
+                           dt_s=dt, want_leaf=bool(want_leaf),
+                           precision=self.precision)
                 # compile accounting (obs/compile.py): every dispatch
                 # wall feeds the steady estimate; miss-bearing ones are
                 # priced against it (warm persistent-cache loads told
                 # apart from true compiles by their tiny excess)
-                _compile.note_dispatch(tele, "predict_blocked", bucket,
+                _compile.note_dispatch(tele, self._site, bucket,
                                        dt, misses)
             if want_leaf:
                 leaves[lo:lo + nc] = np.asarray(
@@ -504,6 +555,10 @@ class FusedPredictor:
         harvest or of the degraded program itself falls all the way back
         to the host TreeSHAP scan (raw rows; counted — a raw contrib
         request is never an exception)."""
+        if self.precision != "exact":
+            raise ValueError("pred_contrib has no lossy tier: SHAP "
+                             "contributions are exact (f64) only; use a "
+                             "precision='exact' predictor")
         n = len(X)
         if self.n_trees == 0 or n == 0:
             return np.zeros((n, int(ncol)), dtype=np.float64)
@@ -662,8 +717,8 @@ class FusedPredictor:
                         bucket, type(exc).__name__, exc)
         # serving runs carry the owning model in the site key so fallback
         # counts surface per model in the registry stats + summary
-        site = ("predict_blocked@%s" % self.owner if self.owner
-                else "predict_blocked")
+        site = ("%s@%s" % (self._site, self.owner) if self.owner
+                else self._site)
         note_fallback(site, reason="%s: %s" % (type(exc).__name__, exc),
                       bucket=int(bucket),
                       **({"model": self.owner} if self.owner else {}))
@@ -675,6 +730,9 @@ class FusedPredictor:
             round_period=int(round_period), want_leaf=want_leaf)
         # the fallback's own compiles are recompiles too — a steady-state
         # degraded loop must also read zero after its first bucket compile
-        _recompile.note_dispatch("predict_fallback", bucket,
-                                 predict_scan_fallback._cache_size())
+        # (both tiers share the fallback jit cache; watch= keys it once)
+        _recompile.note_dispatch(
+            "predict_fallback" if self.precision == "exact"
+            else "predict_fallback_bf16", bucket,
+            predict_scan_fallback._cache_size(), watch="predict_fallback")
         return out

@@ -46,6 +46,8 @@ _SERVE_ROWS = "serve_rows_model_"
 _SERVE_LAT = "serve_latency_s_model_"
 _SERVE_OCC = "serve_occupancy_model_"
 _SERVE_FB = "predict_fallbacks_model_"
+_SERVE_PREC_REQ = "serve_requests_precision_"
+_SERVE_PREC_ROWS = "serve_rows_precision_"
 
 
 def serving_block(counters: Dict[str, Any], gauges: Dict[str, Any],
@@ -80,8 +82,20 @@ def serving_block(counters: Dict[str, Any], gauges: Dict[str, Any],
     for info in models.values():
         req = info.get("requests")
         info["qps"] = (req / wall) if (req and wall) else None
+    # precision-tier traffic split (round 20): which share of the served
+    # requests/rows rode the lossy bf16 tier vs exact.  Keyed per tier;
+    # an all-exact run shows {"exact": ...} only
+    precisions: Dict[str, Dict[str, int]] = {}
+    for name, n in counters.items():
+        if name.startswith(_SERVE_PREC_REQ):
+            precisions.setdefault(name[len(_SERVE_PREC_REQ):],
+                                  {})["requests"] = int(n)
+        elif name.startswith(_SERVE_PREC_ROWS):
+            precisions.setdefault(name[len(_SERVE_PREC_ROWS):],
+                                  {})["rows"] = int(n)
     return {
         "models": models,
+        "precisions": precisions,
         # the never-drop invariant (Server.close records it; None on runs
         # that died before close — the counters above still reconstruct)
         "dropped": gauges.get("serve_dropped"),
@@ -359,6 +373,13 @@ def human_table(summary: Dict[str, Any]) -> str:
                    info.get("fallbacks", 0)))
         row("    batches", "%d (single-row fast %d)"
             % (srv.get("batches", 0), srv.get("single_row_fast", 0)))
+        prec = srv.get("precisions") or {}
+        if prec:
+            row("    precision tiers",
+                " ".join("%s: req=%d rows=%d"
+                         % (tier, info.get("requests", 0),
+                            info.get("rows", 0))
+                         for tier, info in sorted(prec.items())))
         qd = srv.get("queue_depth") or {}
         if qd.get("count"):
             row("    queue depth", "p50=%.6g p99=%.6g"

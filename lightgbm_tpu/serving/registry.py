@@ -105,9 +105,11 @@ def early_stop_allowed(gbdt) -> bool:
 class ResidentModel:
     """One resident model: its booster plus the cached FusedPredictors.
 
-    Predictors are keyed by (kind, start_iter, end_iter, class) — the same
-    key space as ``GBDT._fused_predictor`` — built on first use and owned
-    here so eviction/swap can drop exactly this model's device arrays.
+    Predictors are keyed by (kind, start_iter, end_iter, class, precision)
+    — ``GBDT._fused_predictor``'s key space plus the serving tier — built
+    on first use and owned here so eviction/swap can drop exactly this
+    model's device arrays.  The bf16 tier's stacked ensemble is a separate
+    entry (own arrays, own plan-sized G), never shared with exact.
     ``inflight`` counts dispatches holding the entry; ``retired`` /
     ``evict_pending`` defer the drop until the count drains."""
 
@@ -129,7 +131,8 @@ class ResidentModel:
         # (predictor.hpp:38-47 NeedAccuratePrediction)
         self.early_stop_allowed = early_stop_allowed(self.gbdt)
         self._registry = registry
-        self._preds: Dict[Tuple[str, int, int, int], FusedPredictor] = {}
+        self._preds: Dict[Tuple[str, int, int, int, str],
+                          FusedPredictor] = {}
         self._single: Dict[Tuple[int, int], Any] = {}
         self.inflight = 0
         self.retired = False
@@ -157,15 +160,15 @@ class ResidentModel:
     def supports_binned(self) -> bool:
         return self.layout_ds is not None
 
-    def _predictor(self, kind: str, start: int, end: int,
-                   k: int) -> FusedPredictor:
-        key = (kind, start, end, k)
+    def _predictor(self, kind: str, start: int, end: int, k: int,
+                   precision: str = "exact") -> FusedPredictor:
+        key = (kind, start, end, k, precision)
         pred = self._preds.get(key)
         if pred is None:
             sel = self.gbdt.models[start * self.K:end * self.K][k::self.K]
             pred = FusedPredictor(
                 sel, dataset=self.layout_ds if kind == "binned" else None,
-                kind=kind)
+                kind=kind, precision=precision)
             # per-model attribution for degraded-serving fallback counts —
             # the metric-safe token, so the fallback counter joins the same
             # serving-block model entry as every other serve_* metric
@@ -207,14 +210,17 @@ class ResidentModel:
     def predict(self, rows: np.ndarray, kind: str = "raw",
                 num_iteration: int = -1, start_iteration: int = 0,
                 margin: float = -1.0, freq: int = 10,
-                raw_score: bool = False) -> np.ndarray:
+                raw_score: bool = False,
+                precision: str = "exact") -> np.ndarray:
         """Batched predict through the cached FusedPredictor(s) — always
         the fused bucketed path (never the host fallback), so the
-        steady-state no-recompile gauge covers every serving dispatch."""
+        steady-state no-recompile gauge covers every serving dispatch.
+        ``precision="bf16"`` serves through the lossy tier's own stacked
+        ensemble (budget-gated error; routing bit-exact with exact)."""
         start, end = self._resolve_range(num_iteration, start_iteration)
         raw = np.zeros((self.K, len(rows)), dtype=np.float64)
         for k in range(self.K):
-            raw[k] = self._predictor(kind, start, end, k)(
+            raw[k] = self._predictor(kind, start, end, k, precision)(
                 rows, early_stop_margin=float(margin),
                 round_period=int(freq))
         return self._transform(raw, raw_score)
@@ -254,17 +260,22 @@ class ResidentModel:
         return self._transform(raw, raw_score)
 
     def warm(self, buckets=(PREDICT_BUCKETS[0],),
-             contrib: bool = False) -> None:
+             contrib: bool = False,
+             precisions=("exact",)) -> None:
         """Pre-dispatch one zero batch per bucket so the first real request
         after an admission/swap never waits on a compile (a cache hit when
         the shapes were ever compiled — the no-recompile-stall swap).
         ``contrib=True`` additionally warms the pred_contrib programs for
         the same buckets (a model serving explanation traffic must not
-        pay its schedule harvest + compile on the first live request)."""
+        pay its schedule harvest + compile on the first live request);
+        ``precisions`` picks the serving tiers to warm — a model taking
+        bf16 traffic across a swap wants ``("exact", "bf16")`` so the
+        lossy tier's programs are compiled before the flip too."""
         n_feat = int(self.gbdt.max_feature_idx) + 1
         for b in buckets:
-            self.predict(np.zeros((int(b), n_feat), dtype=np.float32),
-                         raw_score=True)
+            for prec in precisions:
+                self.predict(np.zeros((int(b), n_feat), dtype=np.float32),
+                             raw_score=True, precision=str(prec))
         if contrib:
             for b in buckets:
                 self.predict_contrib(
@@ -281,7 +292,9 @@ class ResidentModel:
                               _plan_state.current_provenance(),
                               key=str(self.name),
                               buckets=",".join(str(int(b))
-                                               for b in buckets))
+                                               for b in buckets),
+                              precisions=",".join(str(p)
+                                                  for p in precisions))
 
     def quality_baseline(self):
         """Drift baseline of this resident generation (delegates to the
@@ -457,7 +470,8 @@ class ModelRegistry:
         return entry
 
     def swap(self, name: str, booster, layout_ds=None,
-             warm=True, warm_contrib: bool = False) -> ResidentModel:
+             warm=True, warm_contrib: bool = False,
+             warm_precisions=("exact",)) -> ResidentModel:
         """Atomically republish ``name``: the replacement is fully stacked
         (and bucket-warmed unless ``warm=False``) BEFORE the flip; in-flight
         requests finish on the old ensemble, new arrivals route to the new
@@ -465,7 +479,9 @@ class ModelRegistry:
         ``warm`` may be True (smallest bucket), an iterable of bucket
         sizes, or False; ``warm_contrib`` additionally pre-compiles the
         pred_contrib programs for the warmed buckets (models serving
-        explanation traffic across the swap)."""
+        explanation traffic across the swap); ``warm_precisions`` picks
+        the tiers warmed before the flip (a model taking mixed
+        exact+bf16 traffic wants both, so neither tier stalls)."""
         name = str(name)
         with self._lock:
             if name not in self._resident and name not in self._parked \
@@ -477,7 +493,8 @@ class ModelRegistry:
         if warm:
             entry.warm((PREDICT_BUCKETS[0],) if warm is True
                        else tuple(int(b) for b in warm),
-                       contrib=warm_contrib)
+                       contrib=warm_contrib,
+                       precisions=tuple(warm_precisions))
         with self._changed:
             # a racing re-admission build finishes first: the swap retires
             # whatever generation it published

@@ -63,6 +63,9 @@ class _BatchKey(NamedTuple):
     raw_score: bool
     contrib: bool        # pred_contrib: [N, F+1] SHAP output — contrib
     #                      and score requests never share a dispatch
+    precision: str       # "exact" | "bf16": the serving tier.  Part of
+    #                      the key, so exact and lossy requests for the
+    #                      same model NEVER coalesce into one dispatch
 
 
 class _Request:
@@ -202,9 +205,10 @@ class Server:
         return self.registry.register(name, booster, layout_ds=layout_ds)
 
     def swap(self, name: str, booster, layout_ds=None, warm=True,
-             warm_contrib: bool = False):
+             warm_contrib: bool = False, warm_precisions=("exact",)):
         return self.registry.swap(name, booster, layout_ds=layout_ds,
-                                  warm=warm, warm_contrib=warm_contrib)
+                                  warm=warm, warm_contrib=warm_contrib,
+                                  warm_precisions=warm_precisions)
 
     # ---- request intake ----
 
@@ -241,7 +245,8 @@ class Server:
                start_iteration: int = 0, pred_early_stop=None,
                pred_early_stop_margin=None,
                pred_early_stop_freq=None,
-               pred_contrib: bool = False) -> Future:
+               pred_contrib: bool = False,
+               precision: str = "exact") -> Future:
         """Enqueue one request (a single row or a micro-batch); returns a
         ``concurrent.futures.Future`` resolving to the same shape/values
         ``GBDT.predict`` (or ``predict_binned``) would produce for exactly
@@ -251,7 +256,21 @@ class Server:
         other contrib requests on the same ladder (never with score
         traffic — the batch key carries the flag), and the single-row
         fast path falls back to batched dispatch (the compiled if/else
-        chain scores only)."""
+        chain scores only).
+
+        ``precision="bf16"`` routes the request through the lossy serving
+        tier (bf16 leaf values + accumulate; routing bit-exact) whose
+        measured error is budget-gated in PERF_BUDGETS.json.  Tiers never
+        share a dispatch (the batch key carries the tier), and contrib
+        requests have no lossy tier."""
+        precision = str(precision)
+        if precision not in ("exact", "bf16"):
+            raise LightGBMError("precision must be 'exact' or 'bf16', "
+                                "got %r" % precision)
+        if pred_contrib and precision != "exact":
+            raise LightGBMError(
+                "pred_contrib has no lossy tier: SHAP contributions are "
+                "served exact (f64) only — submit with precision='exact'")
         if binned:
             rows = np.ascontiguousarray(np.asarray(rows))
             if rows.dtype not in (np.uint8, np.uint16):
@@ -288,9 +307,11 @@ class Server:
                         start_iteration=int(start_iteration),
                         margin=float(margin), freq=int(freq),
                         raw_score=bool(raw_score),
-                        contrib=bool(pred_contrib))
+                        contrib=bool(pred_contrib), precision=precision)
+        # the compiled single-row chain is exact-only: a bf16 request must
+        # ride the batched lossy tier, never silently upgrade to exact
         fast = (self.single_row_fast and not binned and not pred_contrib
-                and len(rows) == 1 and margin < 0)
+                and precision == "exact" and len(rows) == 1 and margin < 0)
         req = _Request(key, rows, fast)
         with self._cond:
             if self._closed:
@@ -451,7 +472,8 @@ class Server:
                 out = entry.predict(
                     rows, kind=key.kind, num_iteration=key.num_iteration,
                     start_iteration=key.start_iteration, margin=key.margin,
-                    freq=key.freq, raw_score=key.raw_score)
+                    freq=key.freq, raw_score=key.raw_score,
+                    precision=key.precision)
         except Exception as exc:  # registry/shape errors — never a drop
             self._fail(batch, exc)
             return
@@ -478,6 +500,14 @@ class Server:
                 # summary block): requests at the scheduler grain; the
                 # predictor's own contrib_calls/rows count dispatches
                 tele.counter("serve_contrib_requests").inc(len(batch))
+            # precision-tier traffic split (round 20): counted for every
+            # tier so an all-exact run still shows "exact" explicitly —
+            # absence of a bf16 line then MEANS no lossy traffic, not
+            # missing accounting
+            tele.counter("serve_requests_precision_%s"
+                         % key.precision).inc(len(batch))
+            tele.counter("serve_rows_precision_%s"
+                         % key.precision).inc(int(nrows))
             if fast:
                 tele.counter("serve_single_row_fast").inc()
             bucket = 1 if fast else min(shape_bucket(nrows),
@@ -497,6 +527,7 @@ class Server:
             tele.event("serve_batch", model=m, requests=len(batch),
                        rows=int(nrows), bucket=int(bucket),
                        fast=bool(fast), contrib=bool(key.contrib),
+                       precision=key.precision,
                        dt_s=done - t0,
                        lat_max_s=done - min(r.t_submit for r in batch),
                        queue_depth=int(depth))

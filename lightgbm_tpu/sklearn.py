@@ -220,17 +220,21 @@ class LGBMModel(_LGBMModelBase):
         return np.asarray([weights.get(v, 1.0) for v in y], dtype=np.float64)
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=None,
-                pred_leaf=False, pred_contrib=False, **kwargs):
+                pred_leaf=False, pred_contrib=False, precision="exact",
+                **kwargs):
         """Predict scores (or, with ``pred_contrib=True``, per-feature
         SHAP contributions [N, F+1] per class through the device
-        path-decomposition kernel — round 19)."""
+        path-decomposition kernel — round 19).  ``precision="bf16"``
+        selects the budget-gated lossy serving tier (leaf routing stays
+        bit-exact; only the weighted leaf sum is bf16)."""
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted, call fit before predict")
         return self._Booster.predict(X, raw_score=raw_score,
                                      start_iteration=start_iteration,
                                      num_iteration=num_iteration,
                                      pred_leaf=pred_leaf,
-                                     pred_contrib=pred_contrib)
+                                     pred_contrib=pred_contrib,
+                                     precision=precision)
 
     @property
     def booster_(self) -> Booster:
@@ -298,10 +302,11 @@ class LGBMClassifier(LGBMModel, _LGBMClassifierBase):
         return super().fit(X, encoded, **kwargs)
 
     def predict(self, X, raw_score=False, start_iteration=0, num_iteration=None,
-                pred_leaf=False, pred_contrib=False, **kwargs):
+                pred_leaf=False, pred_contrib=False, precision="exact",
+                **kwargs):
         result = self.predict_proba(X, raw_score, start_iteration,
                                     num_iteration, pred_leaf, pred_contrib,
-                                    **kwargs)
+                                    precision=precision, **kwargs)
         if callable(self._objective) or raw_score or pred_leaf or pred_contrib:
             return result
         if result.ndim == 1:
@@ -312,9 +317,10 @@ class LGBMClassifier(LGBMModel, _LGBMClassifierBase):
 
     def predict_proba(self, X, raw_score=False, start_iteration=0,
                       num_iteration=None, pred_leaf=False, pred_contrib=False,
-                      **kwargs):
+                      precision="exact", **kwargs):
         result = super().predict(X, raw_score, start_iteration, num_iteration,
-                                 pred_leaf, pred_contrib, **kwargs)
+                                 pred_leaf, pred_contrib, precision=precision,
+                                 **kwargs)
         if callable(self._objective) or raw_score or pred_leaf or pred_contrib:
             return result
         if self._n_classes and self._n_classes > 2:

@@ -73,6 +73,28 @@ def parse_args(argv=None):
                     help="rows for the host TreeSHAP reference wall (it "
                          "is a per-row Python recursion; the per-row cost "
                          "extrapolates)")
+    ap.add_argument("--precision", default="",
+                    help="comma-separated lossy tiers to bench against "
+                         "exact (round 20; e.g. 'bf16'): per-bucket "
+                         "cold/warm walls, measured max |score delta| vs "
+                         "the exact path, steady recompiles, and the "
+                         "device bytes-per-row-tree proxy (bf16 halves "
+                         "the [G,M,L] matrices every row-tree reads)")
+    ap.add_argument("--compact", action="store_true",
+                    help="also run the ensemble-compaction cell (round "
+                         "20, core/compact.py): distill the bench model, "
+                         "report tree/byte reduction, declared vs "
+                         "measured score delta, and AUC delta on the "
+                         "training fixture")
+    ap.add_argument("--leaf-codes", type=int, default=255,
+                    help="compaction codebook size per tree block")
+    ap.add_argument("--prune-frac", type=float, default=0.05,
+                    help="compaction bounded-spread prune budget")
+    ap.add_argument("--leaf-cap", type=int, default=24,
+                    help="compaction per-tree leaf cap (shrinks the "
+                         "[T,M,L] device matrices globally)")
+    ap.add_argument("--eval-rows", type=int, default=2000,
+                    help="rows for the compaction AUC/delta measurement")
     ap.add_argument("--json", default="", help="write results to this path")
     return ap.parse_args(argv)
 
@@ -295,6 +317,115 @@ def main(argv=None):
                  speedup, ok, binned_eq, contrib["recompiles_steady"]))
         if not (ok and binned_eq):
             print("FAIL: contrib correctness spot-check", file=sys.stderr)
+
+    # ---- precision tiers (round 20): lossy bf16 serving vs exact ----
+    if args.precision:
+        from lightgbm_tpu.obs import recompile
+        tiers = {}
+        exact_bytes = int(fp.ens.path_sign.nbytes + fp.ens.leaf_value.nbytes)
+        rt = max(len(trees), 1)
+        worst_delta = 0.0
+        for tier in [t.strip() for t in args.precision.split(",")
+                     if t.strip() and t.strip() != "exact"]:
+            fpt = FusedPredictor(trees, precision=tier)
+            tier_bytes = int(fpt.ens.path_sign.nbytes
+                             + fpt.ens.leaf_value.nbytes)
+            cell = {
+                "g": int(fpt.ens.path_len.shape[1]),
+                # the dispatch-cost proxy the tier targets: bytes of
+                # routing+leaf matrices every row-tree streams per call
+                # (the [G,M,L] operands), halved by the 2-byte tier
+                "ens_bytes": tier_bytes,
+                "ens_bytes_exact": exact_bytes,
+                "bytes_per_row_tree": tier_bytes / rt,
+                "bytes_per_row_tree_exact": exact_bytes / rt,
+                "bytes_ratio": tier_bytes / max(exact_bytes, 1),
+                "points": [],
+            }
+            print("%9s %9s %11s %11s %13s %12s"
+                  % ("rows", "path", "cold_ms", "warm_ms", "rows/s(warm)",
+                     "max|delta|"))
+            max_delta = 0.0
+            for n in sizes:
+                Xq = rows_for(n, X)
+                cold, warm = timed(lambda Xq=Xq: fpt(Xq), args.reps)
+                delta = float(np.max(np.abs(
+                    np.asarray(fp(Xq), np.float64)
+                    - np.asarray(fpt(Xq), np.float64)))) if n else 0.0
+                max_delta = max(max_delta, delta)
+                cell["points"].append({"rows": n, "cold_s": cold,
+                                       "warm_s": warm,
+                                       "max_score_delta": delta})
+                print("%9d %9s %11.3f %11.3f %13.0f %12.3g"
+                      % (n, tier, cold * 1e3, warm * 1e3,
+                         n / max(warm, 1e-12), delta))
+            # steady-state invariant: re-dispatching every bucket after
+            # warmup must hit the jit cache (tiers have their own keys,
+            # so a cold bf16 pass must not recompile the exact entries
+            # either — the gauge counts both)
+            base_rc = recompile.total()
+            for n in sizes:
+                fpt(rows_for(n, X))
+                fp(rows_for(n, X))
+            cell["recompiles_steady"] = recompile.total() - base_rc
+            cell["max_score_delta"] = max_delta
+            worst_delta = max(worst_delta, max_delta)
+            tiers[tier] = cell
+            print("tier %s: max|score delta| %.4g over %s, bytes/row-tree "
+                  "%.0f vs %.0f exact (%.2fx), steady recompiles %d"
+                  % (tier, max_delta, sizes, cell["bytes_per_row_tree"],
+                     cell["bytes_per_row_tree_exact"], cell["bytes_ratio"],
+                     cell["recompiles_steady"]))
+        results["precision"] = tiers
+        # artifact identity for tools/perf_gate.py: headline value is the
+        # worst measured lossy score delta (the budgeted quantity)
+        results["metric"] = "precision_tiers"
+        results["unit"] = "max_abs_score_delta"
+        results["value"] = worst_delta
+
+    # ---- ensemble compaction (round 20, core/compact.py) ----
+    if args.compact:
+        from lightgbm_tpu.core.compact import (compact_booster,
+                                               measure_compaction)
+        gen, cstats = compact_booster(booster, leaf_codes=args.leaf_codes,
+                                      prune_frac=args.prune_frac,
+                                      leaf_cap=args.leaf_cap)
+        ne = min(max(int(args.eval_rows), 1), len(X))
+        y = np.asarray(ds.metadata.label, np.float64)
+        meas = measure_compaction(booster, gen, X[:ne], y=y[:ne])
+        # warm wall original vs compacted at the proxy batch size: the
+        # leaf cap shrinks L for EVERY tree's [G,M,L] operands, so the
+        # contraction itself gets smaller, not just the model file
+        fpc = FusedPredictor(gen.models)
+        n = min(args.proxy_n, max(sizes))
+        Xq = rows_for(n, X)
+        _, warm_orig = timed(lambda: fp(Xq), args.reps)
+        _, warm_comp = timed(lambda: fpc(Xq), args.reps)
+        comp = dict(cstats)
+        comp.update(meas)
+        comp.update({"wall_rows": n, "warm_s_original": warm_orig,
+                     "warm_s_compacted": warm_comp,
+                     "declared_bound_holds":
+                         bool(meas["max_score_delta"]
+                              <= cstats["declared_max_score_delta"])})
+        results["compaction"] = comp
+        results.setdefault("metric", "precision_tiers")
+        results.setdefault("unit", "max_abs_score_delta")
+        results.setdefault("value", float(meas["max_score_delta"]))
+        print("compaction: trees %d nodes %d->%d (%.1f%%), device bytes "
+              "%.1f%% smaller, model bytes %.1f%% smaller, maxL %d->%d"
+              % (cstats["trees"], cstats["nodes_in"], cstats["nodes_out"],
+                 100 * cstats["tree_reduction"],
+                 100 * cstats["byte_reduction"],
+                 100 * cstats["model_byte_reduction"],
+                 cstats["max_leaves_in"], cstats["max_leaves_out"]))
+        print("compaction: score delta %.4g (declared bound %.4g, holds="
+              "%s), auc %.5f -> %.5f (delta %.5f), warm %.3f -> %.3f ms"
+              % (meas["max_score_delta"],
+                 cstats["declared_max_score_delta"],
+                 comp["declared_bound_holds"], meas["auc_in"],
+                 meas["auc_out"], meas["auc_delta"], warm_orig * 1e3,
+                 warm_comp * 1e3))
 
     if args.json:
         with open(args.json, "w") as fh:

@@ -82,6 +82,14 @@ def parse_args(argv=None):
                          "for SHAP contributions — the explanations-SLO "
                          "cell, gated by contrib_p99_factor vs the score "
                          "baseline")
+    ap.add_argument("--precision", default="",
+                    help="comma list of lossy tiers to sweep after the "
+                         "exact cells (round 20; e.g. 'bf16'): the same "
+                         "open-loop windows with every request on that "
+                         "tier, plus the measured max |score delta| vs "
+                         "paired exact submissions per cell — the "
+                         "serving-side error-budget evidence, gated by "
+                         "<tier>_max_score_delta in PERF_BUDGETS.json")
     ap.add_argument("--contrib-qps", default="20",
                     help="comma list of request rates for the contrib "
                          "cells (TreeSHAP is O(depth^2) per row — sweep "
@@ -108,6 +116,12 @@ def parse_args(argv=None):
                  "--swap-mid-run (the contrib-under-swap drill lives in "
                  "tools/fault_injection.py contrib-swap, which republishes "
                  "a same-shape generation)")
+    if args.precision and (args.online or args.swap_mid_run):
+        ap.error("--precision cannot combine with --online or "
+                 "--swap-mid-run (a mid-window replacement is only warmed "
+                 "for exact, so its first bf16 dispatch would pay a "
+                 "compile inside the timed cell; the precision-under-swap "
+                 "drill lives in tools/fault_injection.py precision-swap)")
     return args
 
 
@@ -151,7 +165,7 @@ def _quantile(sorted_vals, q):
 
 
 def run_cell(server, names, pool, req_rows, qps, seconds, swap_fn=None,
-             contrib=False):
+             contrib=False, precision="exact"):
     """One open-loop window; returns the latency/throughput cell dict."""
     import numpy as np
     pool = _tile_rows(pool, req_rows)
@@ -171,7 +185,8 @@ def run_cell(server, names, pool, req_rows, qps, seconds, swap_fn=None,
         lo = (i * req_rows) % max(len(pool) - req_rows, 1)
         t_sub = time.perf_counter()
         fut = server.submit(names[i % len(names)], pool[lo:lo + req_rows],
-                            raw_score=True, pred_contrib=contrib)
+                            raw_score=True, pred_contrib=contrib,
+                            precision=precision)
         # completion time stamped by the dispatcher's done-callback, so the
         # collection loop below cannot inflate earlier requests' latencies
         done_at = {}
@@ -310,6 +325,15 @@ def main(argv=None):
             for r in sorted(set(rows_list)):
                 server.predict(name, _tile_rows(pools[name], r)[:r],
                                pred_contrib=True)
+    precisions = [p.strip() for p in args.precision.split(",")
+                  if p.strip() and p.strip() != "exact"] \
+        if args.precision else []
+    for tier in precisions:
+        # the lossy tiers get their own jit entries (the batch key keeps
+        # them apart from exact by construction), so every rung must warm
+        # per tier or the timed cells measure a compile, not dispatch
+        for name in names:
+            entries[name].warm(warm_rungs, precisions=(tier,))
     base_recompiles = recompile.total()
 
     swap_seq = [0]
@@ -371,6 +395,44 @@ def main(argv=None):
                      "-" if cell["achieved_qps"] is None
                      else "%.0f" % cell["achieved_qps"],
                      cell["failed"]), flush=True)
+    precision_blocks = {}
+    for tier in precisions:
+        tgrid = []
+        tmax_delta = 0.0
+        for req_rows in rows_list:
+            for qps in qps_list:
+                cell = run_cell(server, names, pool, req_rows, qps,
+                                args.seconds, swap_fn=None, precision=tier)
+                cell["precision"] = tier
+                # error evidence rides the cell: one paired exact/tier
+                # submission on the same rows, outside the timed window
+                rows = _tile_rows(pool, req_rows)[:req_rows]
+                ref = server.submit(names[0], rows,
+                                    raw_score=True).result(timeout=120)
+                got = server.submit(names[0], rows, raw_score=True,
+                                    precision=tier).result(timeout=120)
+                delta = float(np.max(np.abs(
+                    np.asarray(ref, np.float64)
+                    - np.asarray(got, np.float64)))) if req_rows else 0.0
+                cell["max_score_delta"] = delta
+                tmax_delta = max(tmax_delta, delta)
+                tgrid.append(cell)
+                print("%s qps=%-6g rows=%-5d p50=%s p99=%s achieved=%s "
+                      "failed=%d max|delta|=%.3g"
+                      % (tier.upper(), qps, req_rows,
+                         "-" if cell["p50_s"] is None
+                         else "%.6f" % cell["p50_s"],
+                         "-" if cell["p99_s"] is None
+                         else "%.6f" % cell["p99_s"],
+                         "-" if cell["achieved_qps"] is None
+                         else "%.0f" % cell["achieved_qps"],
+                         cell["failed"], delta), flush=True)
+        t_p99s = [c["p99_s"] for c in tgrid if c["p99_s"] is not None]
+        precision_blocks[tier] = {
+            "qps": qps_list, "request_rows": rows_list,
+            "value": max(t_p99s) if t_p99s else None, "unit": "s",
+            "max_score_delta": tmax_delta, "grid": tgrid,
+        }
     stats = server.stats()
     online_stats = None
     if controller is not None:
@@ -401,6 +463,8 @@ def main(argv=None):
         "grid": grid,
         "device": os.environ.get("JAX_PLATFORMS", ""),
     }
+    if precision_blocks:
+        artifact["precision"] = precision_blocks
     if contrib_grid:
         c_p99s = [c["p99_s"] for c in contrib_grid if c["p99_s"] is not None]
         artifact["contrib"] = {

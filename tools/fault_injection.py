@@ -1414,7 +1414,142 @@ def scenario_contrib_swap(workdir: str) -> None:
                                          served_old, served_new))
 
 
+def scenario_precision_swap(workdir: str) -> None:
+    """Round 20's serving drill: MIXED exact + bf16 traffic across a
+    mid-traffic hot-swap.  The replacement is a leaf-value-perturbed
+    republish of the same ensemble (identical tree structure, different
+    outputs — exact AND bf16 programs are pure jit-cache hits).  Asserts
+    ZERO dropped requests, every exact response BIT-exact vs the
+    generation that served it, every bf16 response bit-exact vs that
+    generation's bf16 program AND within the declared
+    ``bf16_max_score_delta`` budget of its exact scores, and ZERO
+    steady-state recompiles after warmup."""
+    import json as _json
+    import threading
+
+    import numpy as np
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+    from lightgbm_tpu.obs import recompile
+    from lightgbm_tpu.serving import Server
+
+    with open(os.path.join(REPO, "PERF_BUDGETS.json")) as fh:
+        budget = float(_json.load(fh)["budgets"]["bf16_max_score_delta"])
+
+    rng = np.random.RandomState(7)
+    X = rng.uniform(-2, 2, size=(800, 6)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2)
+         + 0.1 * rng.normal(size=800)).astype(np.float64)
+    cfg = Config(objective="regression", num_leaves=8,
+                 min_data_in_leaf=5, verbosity=-1, num_iterations=10)
+    ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
+                                   min_data_in_leaf=cfg.min_data_in_leaf)
+    bA = create_boosting(cfg.boosting, cfg, ds,
+                         create_objective(cfg.objective, cfg))
+    for _ in range(10):
+        bA.train_one_iter()
+    # the republish: SAME structure, perturbed leaf values (the online
+    # refit shape) — both tiers' programs are pure jit-cache hits
+    bB = GBDT(cfg)
+    bB.load_model_from_string(bA.save_model_to_string())
+    for t in bB.models:
+        t.leaf_value = t.leaf_value * 1.1
+    sizes = (1, 17, 64)
+    # per-generation, per-tier references through the SAME fused programs
+    # serving dispatches: exact responses must be bit-exact vs the exact
+    # program, bf16 responses bit-exact vs the bf16 program (it is
+    # deterministic — lossy, not noisy)
+    from lightgbm_tpu.core.predict_fused import FusedPredictor
+    fps = {("a", "exact"): FusedPredictor(bA.models),
+           ("b", "exact"): FusedPredictor(bB.models),
+           ("a", "bf16"): FusedPredictor(bA.models, precision="bf16"),
+           ("b", "bf16"): FusedPredictor(bB.models, precision="bf16")}
+    refs = {k: {n: np.asarray(fp(X[:n])) for n in sizes}
+            for k, fp in fps.items()}
+    # the error budget holds per generation BEFORE the drill: a swap must
+    # not be the thing that discovers an over-budget tier
+    for gen in ("a", "b"):
+        for n in sizes:
+            worst = float(np.max(np.abs(refs[(gen, "exact")][n]
+                                        - refs[(gen, "bf16")][n])))
+            assert worst <= budget, \
+                "gen %s bf16 delta %g exceeds budget %g" % (gen, worst,
+                                                            budget)
+    srv = Server(max_batch_wait_us=500)
+    srv.register("m", bA)
+    # warm every rung the mixed traffic can coalesce into, on BOTH tiers
+    # (4 threads x 2-outstanding x 64 rows stays under 1024)
+    entry = srv.registry._resident["m"]
+    entry.warm((128, 1024), precisions=("exact", "bf16"))
+    for n in sizes:
+        srv.submit("m", X[:n], raw_score=True).result()
+        srv.submit("m", X[:n], raw_score=True, precision="bf16").result()
+    base = recompile.total()
+
+    results = []
+    res_lock = threading.Lock()
+
+    def traffic(tid):
+        rng_t = np.random.RandomState(200 + tid)
+        outstanding = []
+        for i in range(50):
+            n = int(sizes[rng_t.randint(len(sizes))])
+            tier = "bf16" if (i + tid) % 2 == 0 else "exact"
+            fut = srv.submit("m", X[:n], raw_score=True, precision=tier)
+            with res_lock:
+                results.append((n, tier, fut))
+            outstanding.append(fut)
+            if len(outstanding) >= 2:
+                outstanding.pop(0).result()
+
+    threads = [threading.Thread(target=traffic, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 180
+    while True:
+        with res_lock:
+            submitted = len(results)
+        if submitted >= 40:
+            break
+        assert time.time() < deadline, "traffic stalled before the swap"
+        time.sleep(0.002)
+    srv.swap("m", bB, warm=(128, 1024),
+             warm_precisions=("exact", "bf16"))
+    for t in threads:
+        t.join()
+    srv.close()
+
+    stats = srv.stats()
+    assert stats["dropped"] == 0 and stats["failed"] == 0, stats
+    served_old = served_new = mismatches = 0
+    for n, tier, fut in results:
+        got = np.asarray(fut.result(timeout=60))
+        old = np.array_equal(got, refs[("a", tier)][n])
+        new = np.array_equal(got, refs[("b", tier)][n])
+        served_old += old
+        served_new += new
+        mismatches += not (old or new)
+    assert mismatches == 0, \
+        "%d responses matched neither generation's tier program" % mismatches
+    assert served_new > 0, "no request reached the swapped-in model"
+    n_bf16 = sum(1 for _, tier, _ in results if tier == "bf16")
+    assert n_bf16 > 0, "no bf16 traffic generated"
+    delta = recompile.total() - base
+    assert delta == 0, ("precision-under-swap recompiled %d times after "
+                        "warmup" % delta)
+    print("PASS precision-swap: %d requests (%d bf16, budget %g) served "
+          "across the hot-swap (%d old / %d new generation), 0 drops, "
+          "0 steady-state recompiles" % (len(results), n_bf16, budget,
+                                         served_old, served_new))
+
+
 SCENARIOS = {"kill-write": scenario_kill_write,
+             "precision-swap": scenario_precision_swap,
              "contrib-swap": scenario_contrib_swap,
              "plan-cache": scenario_plan_cache,
              "online-preempt": scenario_online_preempt,

@@ -273,10 +273,18 @@ def summary_from_events(events):
                 + int(e.get("requests", 1))
         if e["kind"] == "serve_batch":
             m = str(e.get("model", "?"))
+            # precision tier (round 20): pre-r20 event streams carry no
+            # precision field — those batches were all exact by
+            # construction, so the default reconstructs them faithfully
+            p = str(e.get("precision", "exact"))
             for ck, n in (("serve_batches", 1),
                           ("serve_requests_model_%s" % m,
                            int(e.get("requests", 1))),
                           ("serve_rows_model_%s" % m, int(e.get("rows", 0))),
+                          ("serve_requests_precision_%s" % p,
+                           int(e.get("requests", 1))),
+                          ("serve_rows_precision_%s" % p,
+                           int(e.get("rows", 0))),
                           ("serve_single_row_fast",
                            1 if e.get("fast") else 0)):
                 if n:

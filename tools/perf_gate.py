@@ -95,6 +95,8 @@ def sniff(doc: dict) -> str:
         return "summary"
     if doc.get("metric") == "plan_autotune":
         return "autotune"
+    if doc.get("metric") == "precision_tiers":
+        return "precision"
     if "grid" in doc and "dropped" in doc:
         return "serve"
     if "level" in doc or ("points" in doc and "fits" in doc):
@@ -173,6 +175,28 @@ def gate_serve(g: Gate, path: str, doc: dict, b: dict, baseline) -> None:
         elif cfac:
             g.skip(path, "contrib p99 vs score cells",
                    "no score headline to compare against")
+    # lossy-tier cells (round 20, bench_serve --precision): every tier's
+    # measured score delta within its declared per-tier budget, every
+    # window complete — the error budget is a gate, not a footnote
+    for tier, block in sorted((doc.get("precision") or {}).items()):
+        bkey = "%s_max_score_delta" % tier
+        bar = b.get(bkey)
+        md = block.get("max_score_delta")
+        if bar is None:
+            g.check(path, "budget declared [%s]" % tier, False,
+                    "lossy tier %r has no %s line in the budgets"
+                    % (tier, bkey))
+        else:
+            g.check(path, "score delta within budget [%s]" % tier,
+                    md is not None and float(md) <= float(bar),
+                    "max|delta| %s <= %s" % (md, bar))
+        cells = block.get("grid") or []
+        g.check(path, "tier cells complete [%s]" % tier,
+                bool(cells) and all(int(c.get("failed", 0)) == 0
+                                    for c in cells),
+                "cells=%d failed=%s"
+                % (len(cells), sum(int(c.get("failed", 0))
+                                   for c in cells)))
 
 
 def gate_split_cost(g: Gate, path: str, doc: dict, b: dict) -> None:
@@ -232,6 +256,67 @@ def gate_autotune(g: Gate, path: str, doc: dict, b: dict) -> None:
                     float(m) >= margin_min,
                     "%.3fx >= %.2fx (analytic/winner steady p50)"
                     % (float(m), margin_min))
+
+
+def gate_precision(g: Gate, path: str, doc: dict, b: dict) -> None:
+    """BENCH_precision artifacts (round 20): every lossy path within its
+    declared error budget, the exact path untouched, and the lossy tiers
+    actually paying for themselves (bytes-per-row-tree win; compaction at
+    or above its declared reduction floors).  Budgets are per-tier
+    (``<tier>_max_score_delta``) so a future f8 tier gets its own line."""
+    tiers = doc.get("precision") or {}
+    for tier, cell in sorted(tiers.items()):
+        bkey = "%s_max_score_delta" % tier
+        bar = b.get(bkey)
+        if bar is None:
+            g.check(path, "budget declared [%s]" % tier, False,
+                    "lossy tier %r has no %s line in the budgets — every "
+                    "lossy path must carry a declared budget" % (tier, bkey))
+            continue
+        md = cell.get("max_score_delta")
+        g.check(path, "score delta within budget [%s]" % tier,
+                md is not None and float(md) <= float(bar),
+                "max|delta| %s <= %s" % (md, bar))
+        bratio = cell.get("bytes_ratio")
+        bmax = b.get("%s_bytes_ratio_max" % tier)
+        if bratio is not None and bmax is not None:
+            g.check(path, "bytes/row-tree win [%s]" % tier,
+                    float(bratio) <= float(bmax),
+                    "%.3fx <= %.3fx (ens bytes vs exact)"
+                    % (float(bratio), float(bmax)))
+        if cell.get("recompiles_steady") is not None:
+            g.check(path, "recompiles steady [%s]" % tier,
+                    int(cell["recompiles_steady"])
+                    <= int(b.get("recompiles_steady", 0)),
+                    "recompiles_steady=%s" % cell["recompiles_steady"])
+    comp = doc.get("compaction")
+    if comp is not None:
+        bar = b.get("compact_auc_delta_max")
+        ad = comp.get("auc_delta")
+        if bar is not None:
+            g.check(path, "compaction auc delta",
+                    ad is not None and float(ad) <= float(bar),
+                    "auc_delta %s <= %s" % (ad, bar))
+        g.check(path, "compaction declared bound holds",
+                comp.get("max_score_delta") is not None
+                and comp.get("declared_max_score_delta") is not None
+                and float(comp["max_score_delta"])
+                <= float(comp["declared_max_score_delta"]),
+                "measured %s <= declared %s"
+                % (comp.get("max_score_delta"),
+                   comp.get("declared_max_score_delta")))
+        for metric, floor_key in (("tree_reduction",
+                                   "compact_tree_reduction_min"),
+                                  ("byte_reduction",
+                                   "compact_byte_reduction_min")):
+            floor = b.get(floor_key)
+            val = comp.get(metric)
+            if floor is not None and val is not None:
+                g.check(path, "compaction %s" % metric,
+                        float(val) >= float(floor),
+                        "%.3f >= %.3f" % (float(val), float(floor)))
+    if not tiers and comp is None:
+        g.skip(path, "precision budgets", "no lossy cells in artifact")
 
 
 def gate_bench_line(g: Gate, path: str, doc: dict, b: dict) -> None:
@@ -385,6 +470,8 @@ def run_gate(artifacts, budgets_path: str) -> int:
                          forensics_baseline=forensics_baseline)
         elif kind == "autotune":
             gate_autotune(g, path, doc, b)
+        elif kind == "precision":
+            gate_precision(g, path, doc, b)
         elif kind == "bench_line":
             gate_bench_line(g, path, doc, b)
         else:
