@@ -179,10 +179,12 @@ class Dataset:
         if self.reference is not None:
             self.reference.construct()
             ref_handle = self.reference.handle
+        chunk_rows = int(getattr(cfg, "data_chunk_rows", 0) or 0)
         csr = _as_csr(self.data)
         if csr is not None and self.categorical_feature in ("auto", None):
             # sparse path: bin straight from CSR, never densify
-            # (sparse_bin.hpp counterpart)
+            # (sparse_bin.hpp counterpart); data_chunk_rows bounds the
+            # materialization window of the packed store
             self.handle = BinnedDataset.from_csr(
                 csr.indptr, csr.indices, csr.values, csr.num_col,
                 label=label, weight=weight, group=group,
@@ -198,12 +200,36 @@ class Dataset:
                                else list(self.feature_name)),
                 max_bin_by_feature=(list(cfg.max_bin_by_feature)
                                     if cfg.max_bin_by_feature else None),
-                reference=ref_handle)
+                reference=ref_handle, data_chunk_rows=chunk_rows)
             if self.free_raw_data:
                 self.data = None
             return self
         mat, names, cats = _to_matrix(self.data, self.feature_name,
                                       self.categorical_feature)
+        if chunk_rows > 0 and self.free_raw_data:
+            # two-pass chunked construction (io/dataset.from_row_chunks):
+            # bit-identical to from_matrix, but the binning working set is
+            # one chunk at a time — the in-memory analog of the streaming
+            # file loader (a raw matrix the caller KEEPS gains nothing, so
+            # free_raw_data=False keeps the one-shot path)
+            self.handle = BinnedDataset.from_row_chunks(
+                lambda: (mat[i:i + chunk_rows]
+                         for i in range(0, mat.shape[0] or 0, chunk_rows)),
+                label=label, weight=weight, group=group,
+                init_score=init_score, max_bin=int(cfg.max_bin),
+                min_data_in_bin=int(cfg.min_data_in_bin),
+                min_data_in_leaf=int(cfg.min_data_in_leaf),
+                bin_construct_sample_cnt=int(cfg.bin_construct_sample_cnt),
+                categorical_feature=cats or (),
+                use_missing=bool(cfg.use_missing),
+                zero_as_missing=bool(cfg.zero_as_missing),
+                data_random_seed=int(cfg.data_random_seed),
+                enable_bundle=bool(cfg.enable_bundle),
+                feature_names=names, reference=ref_handle,
+                max_bin_by_feature=(list(cfg.max_bin_by_feature)
+                                    if cfg.max_bin_by_feature else None))
+            self.data = None
+            return self
         self.handle = BinnedDataset.from_matrix(
             mat, label=label, weight=weight, group=group, init_score=init_score,
             max_bin=int(cfg.max_bin), min_data_in_bin=int(cfg.min_data_in_bin),

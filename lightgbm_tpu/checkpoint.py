@@ -68,18 +68,15 @@ def list_checkpoints(prefix: str) -> List[Tuple[int, str]]:
 
 # ---- dataset identity ----
 
-def dataset_fingerprint(ds) -> Dict[str, Any]:
-    """Cheap identity of a ``BinnedDataset``: row/feature counts plus a
-    CRC32 digest of every feature's bin mapper (bounds, categories, types).
+def mapper_digest(bin_mappers, crc: int = 0) -> int:
+    """Fold every bin mapper (bounds, categories, types) into a CRC32.
 
-    A checkpoint resumed against a *different* dataset silently trains
-    garbage — the restored score caches describe rows that no longer
-    exist; the fingerprint turns that into a hard error.  Deterministic
-    for a given input (binning is deterministic), so rebuilding the same
-    dataset in the resume process matches byte-for-byte."""
-    crc = zlib.crc32(np.asarray(
-        [ds.num_data, ds.num_total_features], dtype=np.int64).tobytes())
-    for m in ds.bin_mappers:
+    Shared by :func:`dataset_fingerprint` (resume identity) and
+    ``parallel.distdata.schema_digest`` (pod-wide mapper agreement) — the
+    sharded loader's "every rank froze the same bins" pin is exactly the
+    mapper part of the resume fingerprint, with the per-rank row count
+    deliberately left out."""
+    for m in bin_mappers:
         crc = zlib.crc32(np.asarray(
             [int(m.num_bin), int(m.bin_type), int(m.missing_type),
              int(m.default_bin)], dtype=np.int64).tobytes(), crc)
@@ -89,9 +86,39 @@ def dataset_fingerprint(ds) -> Dict[str, Any]:
         else:
             crc = zlib.crc32(np.asarray(m.bin_upper_bound,
                                         dtype=np.float64).tobytes(), crc)
-    return {"num_rows": int(ds.num_data),
-            "num_features": int(ds.num_total_features),
-            "bin_digest": "%08x" % (crc & 0xFFFFFFFF)}
+    return crc
+
+
+def dataset_fingerprint(ds) -> Dict[str, Any]:
+    """Cheap identity of a ``BinnedDataset``: row/feature counts plus a
+    CRC32 digest of every feature's bin mapper (bounds, categories, types).
+
+    A checkpoint resumed against a *different* dataset silently trains
+    garbage — the restored score caches describe rows that no longer
+    exist; the fingerprint turns that into a hard error.  Deterministic
+    for a given input (binning is deterministic), so rebuilding the same
+    dataset in the resume process matches byte-for-byte.
+
+    Host-sharded stores (loader ``shard`` stamp) additionally fold the
+    shard bounds: rank 0's stripe of a 2-host run holds different rows
+    than the same file loaded whole, and a resume that silently crossed
+    that line would restore score caches for the wrong rows.  Unsharded
+    datasets keep the exact pre-round-21 digest."""
+    crc = zlib.crc32(np.asarray(
+        [ds.num_data, ds.num_total_features], dtype=np.int64).tobytes())
+    crc = mapper_digest(ds.bin_mappers, crc)
+    out = {"num_rows": int(ds.num_data),
+           "num_features": int(ds.num_total_features)}
+    shard = getattr(ds, "shard", None)
+    if shard:
+        crc = zlib.crc32(np.asarray(
+            [int(shard["rank"]), int(shard["num_machines"]),
+             int(shard["begin"]), int(shard["end"]),
+             int(shard["num_total"])], dtype=np.int64).tobytes(), crc)
+        out["shard"] = {k: int(shard[k]) for k in
+                        ("rank", "num_machines", "begin", "end", "num_total")}
+    out["bin_digest"] = "%08x" % (crc & 0xFFFFFFFF)
+    return out
 
 
 # ---- RNG state (np.random.RandomState <-> JSON) ----

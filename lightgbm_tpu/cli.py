@@ -174,8 +174,33 @@ class Application:
         try:
             loader = DatasetLoader(cfg)
             num_machines = max(int(cfg.num_machines), 1)
-            rank = 0  # single-host CLI; multi-chip parallelism is in-process
-            train_data = loader.load_from_file(cfg.data, rank, num_machines)
+            # pod rank resolution: under jax.distributed each host process
+            # loads (and with data_chunk_rows, even SCANS) only its row
+            # stripe; a single-process runtime keeps rank 0 and the
+            # in-process multi-chip parallelism unchanged
+            from .parallel.distdata import pod_info
+            rank, pod = pod_info()
+            if pod > 1:
+                if int(cfg.num_machines) > 1 and int(cfg.num_machines) != pod:
+                    Log.warning("num_machines=%d but the jax.distributed pod "
+                                "has %d processes; using the pod size",
+                                int(cfg.num_machines), pod)
+                num_machines = pod
+            else:
+                rank = 0
+            from .resilience import EXIT_PREEMPTED, TrainingPreempted
+            try:
+                train_data = loader.load_from_file(cfg.data, rank,
+                                                   num_machines)
+            except TrainingPreempted as exc:
+                # mid-ingest preemption: nothing durable was written (the
+                # binned store only hits disk via save_binary's atomic
+                # rename AFTER the last chunk), so a rerun simply
+                # re-ingests — same resumable exit code as training
+                Log.warning("preempted during ingest (%s); exiting with "
+                            "code %d (resumable: rerun re-ingests)", exc,
+                            EXIT_PREEMPTED)
+                raise SystemExit(EXIT_PREEMPTED)
             Log.info("Finished loading data: %d rows, %d features",
                      train_data.num_data, train_data.num_features)
             objective = create_objective(cfg.objective, cfg)
@@ -212,7 +237,6 @@ class Application:
                 from .checkpoint import restore_state
                 restore_state(booster, ckpt_state)
             it_start = int(booster.iter_)  # nonzero on a checkpoint resume
-            from .resilience import EXIT_PREEMPTED, TrainingPreempted
             try:
                 booster.train(snapshot_out=cfg.output_model)
             except TrainingPreempted as exc:

@@ -336,6 +336,20 @@ class Config:
                         " windows are capped by the buffer",
                         int(self.online_window_rows),
                         int(self.online_buffer_rows))
+        # round-21 streaming-ingest params: chunked construction re-stripes
+        # the file per rank internally; combining it with an input that is
+        # ALREADY sharded per machine (pre_partition) would silently shard
+        # twice and train each rank on a stripe of a stripe — hard error,
+        # the two knobs are different answers to the same question
+        if int(self.data_chunk_rows) > 0 and bool(self.pre_partition):
+            Log.fatal("data_chunk_rows is incompatible with "
+                      "pre_partition=true: pre-partitioned inputs are "
+                      "already one shard per machine, the streaming loader "
+                      "would shard them again (drop one of the two)")
+        if 0 < int(self.data_chunk_rows) < 1024:
+            Log.warning("data_chunk_rows=%d is very small; per-chunk parse "
+                        "overhead will dominate (typical: 65536-1048576)",
+                        int(self.data_chunk_rows))
         if ("io_retry_attempts" in self.raw_params
                 or "io_retry_backoff_s" in self.raw_params):
             # the retry policy guards a process-global primitive

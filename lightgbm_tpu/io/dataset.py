@@ -23,11 +23,13 @@ TPU-first departures from the reference layout:
 """
 from __future__ import annotations
 
+import io
 import json
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from . import sample as _sample
 from .binning import BinMapper, BinType, MissingType
 from .metadata import Metadata
 from ..utils.log import Log
@@ -95,6 +97,7 @@ class BinnedDataset:
         self.feature_names = (list(feature_names) if feature_names is not None
                               else ["Column_%d" % i for i in range(self.num_total_features)])
 
+        schema_adopted = False
         if reference is not None:
             # validation data reuses the training bin mappers
             # (dataset_loader.cpp:230 LoadFromFileAlignWithOtherDataset)
@@ -111,16 +114,29 @@ class BinnedDataset:
                           len(bin_mappers), self.num_total_features)
             self.bin_mappers = list(bin_mappers)
         else:
-            self._find_bin_mappers(data, max_bin, min_data_in_bin, min_data_in_leaf,
-                                   bin_construct_sample_cnt, categorical_feature,
-                                   use_missing, zero_as_missing, data_random_seed,
-                                   forced_bins, max_bin_by_feature)
+            # the round-21 shared schema path: the SAME deterministic sample
+            # + freeze the streaming loader uses, so an in-memory load and a
+            # chunked/sharded load of identical rows agree byte-for-byte
+            idx, keys = _sample.bottom_k_indices(
+                self.num_data, bin_construct_sample_cnt, data_random_seed)
+            self._adopt_schema(cls.schema_from_sample(
+                data[idx], keys, max_bin=max_bin,
+                min_data_in_bin=min_data_in_bin,
+                min_data_in_leaf=min_data_in_leaf,
+                categorical_feature=categorical_feature,
+                use_missing=use_missing, zero_as_missing=zero_as_missing,
+                feature_names=self.feature_names, forced_bins=forced_bins,
+                max_bin_by_feature=max_bin_by_feature,
+                enable_bundle=enable_bundle))
+            schema_adopted = True
 
-        self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
-                                 if not m.is_trivial]
-        self.inner_feature_map = {f: j for j, f in enumerate(self.used_feature_idx)}
-        self.num_bin_per_feature = [self.bin_mappers[i].num_bin
-                                    for i in self.used_feature_idx]
+        if not schema_adopted:
+            self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
+                                     if not m.is_trivial]
+            self.inner_feature_map = {f: j for j, f
+                                      in enumerate(self.used_feature_idx)}
+            self.num_bin_per_feature = [self.bin_mappers[i].num_bin
+                                        for i in self.used_feature_idx]
         col_dtype = (np.uint8 if max(self.num_bin_per_feature, default=2) <= 256
                      else np.uint16)
         cols = [self.bin_mappers[i].values_to_bins(data[:, i]).astype(col_dtype)
@@ -130,7 +146,7 @@ class BinnedDataset:
             self.group_idx = reference.group_idx
             self.bin_offset = reference.bin_offset
             self.num_bin_per_group = list(reference.num_bin_per_group)
-        else:
+        elif not schema_adopted:
             self.feature_groups = (self._find_groups_from_cols(cols)
                                    if enable_bundle
                                    else [[j] for j in range(len(cols))])
@@ -138,6 +154,188 @@ class BinnedDataset:
         self.binned = self._bundle_columns(cols)
         if keep_raw:
             self.raw_data = data
+        return self
+
+    @classmethod
+    def schema_from_sample(cls, sample: np.ndarray,
+                           sample_keys: Optional[np.ndarray] = None, *,
+                           max_bin: int = 255, min_data_in_bin: int = 3,
+                           min_data_in_leaf: int = 20,
+                           categorical_feature: Sequence[int] = (),
+                           use_missing: bool = True,
+                           zero_as_missing: bool = False,
+                           feature_names: Optional[Sequence[str]] = None,
+                           forced_bins: Optional[Dict[int, List[float]]] = None,
+                           max_bin_by_feature: Optional[Sequence[int]] = None,
+                           enable_bundle: bool = True) -> "BinnedDataset":
+        """Freeze the full dataset *schema* — BinMappers, used-feature set,
+        EFB groups, group layout — from the bin-construct sample ALONE
+        (``CostructFromSampleData`` minus the bulk binning): the returned
+        dataset has zero rows and exists to be adopted by a constructor
+        that then materializes the store (``from_matrix``, the streaming
+        loader's pass 2, or every rank of a pod after the sample
+        allgather).  ``sample`` must be the index-ascending winners of the
+        :mod:`sample` hash-priority draw and ``sample_keys`` their aligned
+        keys (None = natural order, i.e. the sample IS the whole data),
+        so the EFB conflict scan's 64Ki sub-sample is deterministic too."""
+        sample = np.ascontiguousarray(sample, dtype=np.float64)
+        if sample.ndim != 2:
+            Log.fatal("Bin-construct sample must be 2-dimensional")
+        self = cls()
+        self.num_data = 0
+        self.num_total_features = sample.shape[1]
+        self.metadata = Metadata(0)
+        self.feature_names = (list(feature_names)
+                              if feature_names is not None
+                              else ["Column_%d" % i
+                                    for i in range(sample.shape[1])])
+        if max_bin_by_feature:
+            if len(max_bin_by_feature) != self.num_total_features:
+                Log.fatal("Size of max_bin_by_feature (%d) does not match "
+                          "the number of features (%d)",
+                          len(max_bin_by_feature), self.num_total_features)
+            if min(max_bin_by_feature) < 2:
+                Log.fatal("Each entry of max_bin_by_feature must be at least 2")
+        total = len(sample)
+        cat = set(int(c) for c in categorical_feature)
+        self.bin_mappers = []
+        for f in range(self.num_total_features):
+            col = sample[:, f]
+            # sparse sampling contract: pass non-zero (plus NaN) values only,
+            # zeros are implied by total_sample_cnt (dataset_loader.cpp:819)
+            nz = col[(col != 0.0) | np.isnan(col)]
+            m = BinMapper()
+            fmax = (int(max_bin_by_feature[f]) if max_bin_by_feature
+                    else int(max_bin))
+            m.find_bin(nz, total, fmax, min_data_in_bin,
+                       min_split_data=min_data_in_leaf,
+                       bin_type=(BinType.CATEGORICAL if f in cat
+                                 else BinType.NUMERICAL),
+                       use_missing=use_missing,
+                       zero_as_missing=zero_as_missing,
+                       forced_upper_bounds=(forced_bins or {}).get(f))
+            if m.is_trivial:
+                Log.debug("Feature %s is trivial (constant or filtered)",
+                          self.feature_names[f] if self.feature_names
+                          else str(f))
+            self.bin_mappers.append(m)
+        self.used_feature_idx = [i for i, m in enumerate(self.bin_mappers)
+                                 if not m.is_trivial]
+        self.inner_feature_map = {f: j for j, f
+                                  in enumerate(self.used_feature_idx)}
+        self.num_bin_per_feature = [self.bin_mappers[i].num_bin
+                                    for i in self.used_feature_idx]
+        if enable_bundle and len(self.used_feature_idx) > 1:
+            eff = min(total, self._EFB_SAMPLE)
+            pos = (_sample.efb_positions(sample_keys, eff)
+                   if sample_keys is not None else np.arange(eff))
+            active = [np.asarray(self.bin_mappers[i].values_to_bins(
+                          sample[pos, i]) != 0)
+                      for i in self.used_feature_idx]
+            self.feature_groups = self._find_groups(active)
+        else:
+            self.feature_groups = [[j] for j in
+                                   range(len(self.used_feature_idx))]
+        self._assign_group_layout()
+        self.binned = self._bundle_columns([], num_rows=0)
+        return self
+
+    def _adopt_schema(self, schema: "BinnedDataset") -> None:
+        """Take another dataset's frozen schema (mappers, used features,
+        EFB layout, names) — the receiving constructor only materializes
+        rows.  ``reference=`` datasets qualify as schemas too."""
+        self.bin_mappers = schema.bin_mappers
+        self.feature_names = list(schema.feature_names)
+        self.used_feature_idx = list(schema.used_feature_idx)
+        self.inner_feature_map = dict(schema.inner_feature_map)
+        self.num_bin_per_feature = list(schema.num_bin_per_feature)
+        self.feature_groups = [list(g) for g in schema.feature_groups]
+        self.group_idx = schema.group_idx
+        self.bin_offset = schema.bin_offset
+        self.num_bin_per_group = list(schema.num_bin_per_group)
+
+    @classmethod
+    def from_row_chunks(cls, chunks_factory: Callable[[], Iterable[np.ndarray]],
+                        label=None, weight=None, group=None, init_score=None,
+                        max_bin: int = 255, min_data_in_bin: int = 3,
+                        min_data_in_leaf: int = 20,
+                        bin_construct_sample_cnt: int = 200000,
+                        categorical_feature: Sequence[int] = (),
+                        use_missing: bool = True,
+                        zero_as_missing: bool = False,
+                        data_random_seed: int = 1,
+                        feature_names: Optional[Sequence[str]] = None,
+                        forced_bins: Optional[Dict[int, List[float]]] = None,
+                        max_bin_by_feature: Optional[Sequence[int]] = None,
+                        reference: Optional["BinnedDataset"] = None,
+                        enable_bundle: bool = True) -> "BinnedDataset":
+        """Two-pass streaming construction from re-iterable ``[m, F]`` raw
+        chunks: pass 1 runs the hash-priority sampler over the chunks and
+        freezes the schema (byte-identical to ``from_matrix`` over the
+        concatenated rows, by sample determinism); pass 2 re-iterates,
+        binning + bundling each chunk straight into the preallocated
+        store.  Peak memory is O(chunk + sample + binned store) — the raw
+        f64 matrix never exists.  ``chunks_factory`` is called once per
+        pass and must yield the same rows both times."""
+        smp = _sample.RowSampler(bin_construct_sample_cnt, data_random_seed)
+        num_cols = None
+        base = 0
+        for part in chunks_factory():
+            part = np.ascontiguousarray(part, dtype=np.float64)
+            if part.ndim != 2:
+                Log.fatal("Row chunks must be 2-dimensional")
+            if num_cols is None:
+                num_cols = part.shape[1]
+            elif part.shape[1] != num_cols:
+                Log.fatal("Row chunk has %d columns, expected %d",
+                          part.shape[1], num_cols)
+            smp.observe(np.arange(base, base + len(part), dtype=np.int64),
+                        part)
+            base += len(part)
+        n = base
+        _, keys, sample = smp.result()
+        if sample is None:
+            sample = np.zeros((0, num_cols or 0), dtype=np.float64)
+        self = cls()
+        self.num_data = n
+        self.num_total_features = int(num_cols or 0)
+        self.metadata = Metadata(n)
+        if label is not None:
+            self.metadata.set_label(label)
+        if weight is not None:
+            self.metadata.set_weights(weight)
+        if group is not None:
+            self.metadata.set_group(group)
+        if init_score is not None:
+            self.metadata.set_init_score(init_score)
+        if reference is not None:
+            if reference.num_total_features != self.num_total_features:
+                Log.fatal("Validation data has %d features, train data has %d",
+                          self.num_total_features,
+                          reference.num_total_features)
+            self._adopt_schema(reference)
+        else:
+            self._adopt_schema(cls.schema_from_sample(
+                sample, keys, max_bin=max_bin,
+                min_data_in_bin=min_data_in_bin,
+                min_data_in_leaf=min_data_in_leaf,
+                categorical_feature=categorical_feature,
+                use_missing=use_missing, zero_as_missing=zero_as_missing,
+                feature_names=feature_names, forced_bins=forced_bins,
+                max_bin_by_feature=max_bin_by_feature,
+                enable_bundle=enable_bundle))
+        out = np.zeros((n, len(self.feature_groups)),
+                       dtype=self._bundle_columns([], num_rows=0).dtype)
+        pos = 0
+        for part in chunks_factory():
+            part = np.ascontiguousarray(part, dtype=np.float64)
+            out[pos:pos + len(part)] = self.bundle_rows(part)
+            pos += len(part)
+        if pos != n:
+            Log.fatal("Chunk source yielded %d rows on pass 2, %d on pass 1",
+                      pos, n)
+        self.binned = out
+        self.raw_data = None
         return self
 
     @classmethod
@@ -150,7 +348,8 @@ class BinnedDataset:
                  feature_names: Optional[Sequence[str]] = None,
                  max_bin_by_feature: Optional[Sequence[int]] = None,
                  enable_bundle: bool = True,
-                 reference: Optional["BinnedDataset"] = None
+                 reference: Optional["BinnedDataset"] = None,
+                 data_chunk_rows: int = 0
                  ) -> "BinnedDataset":
         """Construct from CSR sparse input WITHOUT densifying.
 
@@ -188,12 +387,11 @@ class BinnedDataset:
         vals_by_col = vals[order]
         col_start = np.searchsorted(col_sorted, np.arange(f_total + 1))
 
-        rng = np.random.RandomState(data_random_seed)
-        if n > bin_construct_sample_cnt:
-            sample_idx = np.sort(rng.choice(n, size=bin_construct_sample_cnt,
-                                            replace=False))
-        else:
-            sample_idx = np.arange(n)
+        # same hash-priority draw as the dense/streaming constructors
+        # (identical indices for identical (n, seed) — the loaders' shared
+        # sampling discipline since round 21)
+        sample_idx, sample_keys = _sample.bottom_k_indices(
+            n, bin_construct_sample_cnt, data_random_seed)
         total = len(sample_idx)
         in_sample = np.zeros(n, dtype=bool)
         in_sample[sample_idx] = True
@@ -245,10 +443,13 @@ class BinnedDataset:
             self.bin_offset = reference.bin_offset
             self.num_bin_per_group = list(reference.num_bin_per_group)
         elif enable_bundle:
-            # sampled active bitmaps (code != 0) straight from the sparse codes
+            # sampled active bitmaps (code != 0) straight from the sparse
+            # codes; the 64Ki sub-sample is the bottom-eff-by-key subset —
+            # the same rows schema_from_sample's dense scan would use
             samp_pos = np.full(n, -1, dtype=np.int64)
             eff = min(total, self._EFB_SAMPLE)
-            samp_pos[sample_idx[:eff]] = np.arange(eff)
+            efb_rows = sample_idx[_sample.efb_positions(sample_keys, eff)]
+            samp_pos[efb_rows] = np.arange(eff)
             active = []
             for j in range(len(self.used_feature_idx)):
                 a = np.zeros(eff, dtype=bool)
@@ -265,16 +466,31 @@ class BinnedDataset:
         dtype = np.uint8 if max_nb <= 256 else np.uint16
         out = np.zeros((n, len(self.feature_groups)), dtype=dtype)
         for g, feats in enumerate(self.feature_groups):
-            if len(feats) == 1:
-                j = feats[0]
-                if zero_bin[j]:
-                    out[:, g] = dtype(zero_bin[j])
-                out[rows_f[j], g] = codes_f[j].astype(dtype)
-            else:
-                for j in feats:  # push order: later features win conflicts
-                    nz = codes_f[j] != 0
-                    out[rows_f[j][nz], g] = (self.bin_offset[j]
-                                             + codes_f[j][nz] - 1).astype(dtype)
+            if len(feats) == 1 and zero_bin[feats[0]]:
+                out[:, g] = dtype(zero_bin[feats[0]])
+        # row-windowed scatter: per-feature nonzeros are row-ascending (the
+        # stable CSC sort preserves CSR row order), so each window is a
+        # searchsorted slice and ``data_chunk_rows=0`` is the one-window
+        # case — byte-identical output by disjointness of the windows
+        step = (int(data_chunk_rows) if int(data_chunk_rows or 0) > 0
+                else max(n, 1))
+        for r0 in range(0, max(n, 1), step):
+            r1 = min(r0 + step, n)
+            for g, feats in enumerate(self.feature_groups):
+                if len(feats) == 1:
+                    j = feats[0]
+                    lo = np.searchsorted(rows_f[j], r0)
+                    hi = np.searchsorted(rows_f[j], r1)
+                    out[rows_f[j][lo:hi], g] = codes_f[j][lo:hi].astype(dtype)
+                else:
+                    for j in feats:  # push order: later features win conflicts
+                        lo = np.searchsorted(rows_f[j], r0)
+                        hi = np.searchsorted(rows_f[j], r1)
+                        c = codes_f[j][lo:hi]
+                        r = rows_f[j][lo:hi]
+                        nz = c != 0
+                        out[r[nz], g] = (self.bin_offset[j]
+                                         + c[nz] - 1).astype(dtype)
         self.binned = out
         self.raw_data = None
         return self
@@ -418,36 +634,6 @@ class BinnedDataset:
             out[mine, j] = (col[mine] - off + 1).astype(dtype)
         return out
 
-    def _find_bin_mappers(self, data, max_bin, min_data_in_bin, min_data_in_leaf,
-                          sample_cnt, categorical_feature, use_missing,
-                          zero_as_missing, seed, forced_bins, max_bin_by_feature):
-        rng = np.random.RandomState(seed)
-        n = self.num_data
-        if n > sample_cnt:
-            sample_idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
-        else:
-            sample_idx = np.arange(n)
-        total = len(sample_idx)
-        cat = set(int(c) for c in categorical_feature)
-        self.bin_mappers = []
-        for f in range(self.num_total_features):
-            col = data[sample_idx, f]
-            # sparse sampling contract: pass non-zero (plus NaN) values only,
-            # zeros are implied by total_sample_cnt (dataset_loader.cpp:819)
-            nz = col[(col != 0.0) | np.isnan(col)]
-            m = BinMapper()
-            fmax = (int(max_bin_by_feature[f]) if max_bin_by_feature
-                    else int(max_bin))
-            m.find_bin(nz, total, fmax, min_data_in_bin,
-                       min_split_data=min_data_in_leaf,
-                       bin_type=BinType.CATEGORICAL if f in cat else BinType.NUMERICAL,
-                       use_missing=use_missing, zero_as_missing=zero_as_missing,
-                       forced_upper_bounds=(forced_bins or {}).get(f))
-            if m.is_trivial:
-                Log.debug("Feature %s is trivial (constant or filtered)",
-                          self.feature_names[f] if self.feature_names else str(f))
-            self.bin_mappers.append(m)
-
     # ---- device view ----
 
     def device_view(self):
@@ -509,19 +695,23 @@ class BinnedDataset:
             "binned_dtype": str(self.binned.dtype),
             "feature_groups": self.feature_groups,
         }
-        with open(path, "wb") as fh:
-            fh.write(self.MAGIC)
-            hdr = json.dumps(header).encode()
-            fh.write(len(hdr).to_bytes(8, "little"))
-            fh.write(hdr)
-            np.save(fh, self.binned, allow_pickle=False)
-            np.save(fh, self.metadata.label, allow_pickle=False)
-            if self.metadata.weights is not None:
-                np.save(fh, self.metadata.weights, allow_pickle=False)
-            if self.metadata.query_boundaries is not None:
-                np.save(fh, self.metadata.query_boundaries, allow_pickle=False)
-            if self.metadata.init_score is not None:
-                np.save(fh, self.metadata.init_score, allow_pickle=False)
+        buf = io.BytesIO()
+        buf.write(self.MAGIC)
+        hdr = json.dumps(header).encode()
+        buf.write(len(hdr).to_bytes(8, "little"))
+        buf.write(hdr)
+        np.save(buf, self.binned, allow_pickle=False)
+        np.save(buf, self.metadata.label, allow_pickle=False)
+        if self.metadata.weights is not None:
+            np.save(buf, self.metadata.weights, allow_pickle=False)
+        if self.metadata.query_boundaries is not None:
+            np.save(buf, self.metadata.query_boundaries, allow_pickle=False)
+        if self.metadata.init_score is not None:
+            np.save(buf, self.metadata.init_score, allow_pickle=False)
+        # atomic: a preemption (or ENOSPC) mid-save must never leave a
+        # partial store at the destination — same discipline as checkpoints
+        from ..utils.file_io import atomic_write
+        atomic_write(path, buf.getvalue())
         Log.info("Saved binary dataset to %s", path)
 
     @classmethod

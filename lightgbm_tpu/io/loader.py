@@ -14,6 +14,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from . import sample as _sample
 from .dataset import BinnedDataset
 from .metadata import Metadata
 from .parser import detect_format, parse_file
@@ -251,9 +252,15 @@ class DatasetLoader:
         if _is_binary_file(filename):
             ds = BinnedDataset.load_binary(filename)
             return ds
+        chunk_rows = int(getattr(cfg, "data_chunk_rows", 0) or 0)
+        if chunk_rows > 0:
+            depth = int(getattr(cfg, "ingest_pipeline_depth", 2) or 2)
+            return self._load_streaming(filename, rank, num_machines,
+                                        reference, chunk_rows, depth)
         if bool(cfg.two_round):
-            return self._load_two_round(filename, rank, num_machines,
-                                        reference)
+            return self._load_streaming(filename, rank, num_machines,
+                                        reference, int(self._TWO_ROUND_CHUNK),
+                                        2)
         header = bool(cfg.header) if cfg.header else None
         is_libsvm = detect_format(filename)[0] == "libsvm"
         if is_libsvm:
@@ -322,6 +329,10 @@ class DatasetLoader:
             max_bin_by_feature=(list(cfg.max_bin_by_feature)
                                 if cfg.max_bin_by_feature else None),
             reference=reference, bin_mappers=mappers)
+        if num_machines > 1 and cfg.pre_partition is False:
+            ds.shard = {"rank": int(rank), "num_machines": int(num_machines),
+                        "begin": int(begin), "end": int(end),
+                        "num_total": int(n_total)}
         if cfg.save_binary:
             ds.save_binary(filename + ".bin")
         return ds
@@ -336,14 +347,21 @@ class DatasetLoader:
     _TWO_ROUND_CHUNK = 65536
 
     @staticmethod
-    def _prefetch(iterator, depth: int = 2):
+    def _prefetch(iterator, depth: int = 2, stats: Optional[dict] = None):
         """Background-thread chunk prefetch — the ``PipelineReader`` role
         (include/LightGBM/utils/pipeline_reader.h:24 double-buffered read):
         the next chunk is read+parsed while the consumer bins the current
-        one (pandas' C parser and numpy binning both release the GIL)."""
+        one (pandas' C parser and numpy binning both release the GIL).
+
+        ``stats`` (optional dict) accumulates ``stall_s`` — wall time the
+        consumer spent blocked waiting on the producer, i.e. the part of
+        ingest the pipeline did NOT hide; the ``ingest`` telemetry block
+        reports it so an under-depth pipeline shows up as a number, not a
+        hunch."""
         import queue
         import threading
-        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        import time as _time
+        q: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
         sentinel = object()
         err = []
         dead = threading.Event()
@@ -372,7 +390,11 @@ class DatasetLoader:
         threading.Thread(target=worker, daemon=True).start()
         try:
             while True:
+                t0 = _time.perf_counter()
                 item = q.get()
+                if stats is not None:
+                    stats["stall_s"] = (stats.get("stall_s", 0.0)
+                                        + _time.perf_counter() - t0)
                 if item is sentinel:
                     if err:
                         raise err[0]
@@ -383,23 +405,82 @@ class DatasetLoader:
             # the worker so the underlying file handle is released
             dead.set()
 
-    def _load_two_round(self, filename: str, rank: int = 0,
+    def _load_streaming(self, filename: str, rank: int = 0,
                         num_machines: int = 1,
-                        reference: Optional[BinnedDataset] = None
-                        ) -> BinnedDataset:
-        from .parser import sample_stream, stream_file
+                        reference: Optional[BinnedDataset] = None,
+                        chunk_rows: int = 65536,
+                        depth: int = 2) -> BinnedDataset:
+        """Two-pass streaming construction — the ``two_round`` role
+        (dataset_loader.cpp two_round + SampleTextDataFromFile) and the
+        round-21 ``data_chunk_rows`` hot path.
+
+        Pass 1 scans RAW lines once, keeping the hash-priority bottom-k
+        sample (io/sample.py) — under a real collective each rank scans
+        only its stripe and the candidate pools ride one allgather, so
+        every rank freezes byte-identical BinMappers from the exact sample
+        a serial full scan draws.  Pass 2 re-reads only this rank's stripe
+        in bounded chunks through the prefetch pipeline and bins straight
+        into the packed store: the raw [N, F] f64 matrix never exists
+        (peak RSS ~ chunk + sample + store/d), and a preemption signal at
+        any chunk boundary aborts with nothing partial on disk
+        (``save_binary`` is atomic and runs only after the full pass).
+        """
+        import time
+
+        from .. import obs, resilience
+        from ..obs import hostmem
+        from .parser import count_data_rows, hash_sample_lines, stream_file
 
         cfg = self.config
         header = bool(cfg.header) if cfg.header else None
         fmt = detect_format(filename)[0]
-        sample, total_rows, full_cols = sample_stream(
-            filename, int(cfg.bin_construct_sample_cnt),
-            seed=int(cfg.data_random_seed), header=header,
-            chunk_rows=self._TWO_ROUND_CHUNK)
-        Log.info("two_round: sampled %d of %d rows from %s",
+        sample_cnt = int(cfg.bin_construct_sample_cnt)
+        seed = int(cfg.data_random_seed)
+        tele = obs.active()
+
+        # ---- pass 1: hash-priority sample + row count + width ----
+        t0 = time.perf_counter()
+        striped = num_machines > 1 and cfg.pre_partition is False
+        allgather = getattr(self, "allgather_fn", None)
+        if striped and allgather is None:
+            import jax as _jax
+            if _jax.process_count() > 1:
+                allgather = _default_allgather(num_machines)
+        use_collective = striped and allgather is not None
+        if use_collective:
+            # each rank scans ONLY its stripe; O(sample_cnt) candidates ride
+            # one allgather and every rank merges the identical global
+            # sample (stripe decomposition of bottom-k, io/sample.py)
+            total_rows = count_data_rows(filename, header=header)
+            begin = total_rows * rank // num_machines
+            end = total_rows * (rank + 1) // num_machines
+            idx, keys, smat, scanned, width = hash_sample_lines(
+                filename, sample_cnt, seed, header=header,
+                skip_rows=begin, max_rows=end - begin, base_index=begin)
+            parts = allgather(_sample.encode_payload(
+                idx, keys, smat, scanned, width))
+            idx, keys, sample, gathered, full_cols = _sample.merge_payloads(
+                parts, sample_cnt)
+            if gathered != total_rows:
+                Log.fatal("sharded ingest: allgathered row count %d does not "
+                          "match the counted %d", gathered, total_rows)
+        else:
+            # no collective available: scan the whole file so stripes of a
+            # single-process "pod" still share one global sample
+            idx, keys, sample, total_rows, full_cols = hash_sample_lines(
+                filename, sample_cnt, seed, header=header)
+            begin, end = 0, total_rows
+            if striped:
+                begin = total_rows * rank // num_machines
+                end = total_rows * (rank + 1) // num_machines
+        n_kept = end - begin
+        hostmem.note()
+        Log.info("streaming ingest: sampled %d of %d rows from %s",
                  len(sample), total_rows, filename)
-        if fmt == "libsvm":
-            full_cols += 1   # sample matrix carries the label at column 0
+        if tele is not None:
+            tele.event("ingest", phase="sample", rows=int(total_rows),
+                       sampled=int(len(sample)),
+                       dt_s=round(time.perf_counter() - t0, 6))
 
         # column resolution (full-file coordinates; LibSVM fixes label at 0)
         names = None
@@ -416,36 +497,30 @@ class DatasetLoader:
         keep = cols.keep
         feat_names = [names[i] for i in keep] if names is not None else None
 
-        # rank stripe (dataset_loader.cpp:168 pre_partition)
-        begin, end = 0, total_rows
-        if num_machines > 1 and cfg.pre_partition is False:
-            begin = total_rows * rank // num_machines
-            end = total_rows * (rank + 1) // num_machines
-        n_kept = end - begin
-
-        # schema (mappers + EFB groups) from the sample
+        # schema (mappers + EFB groups) frozen from the sample
         forced_bins = None
         if getattr(cfg, "forcedbins_filename", ""):
             forced_bins = _load_forced_bins(cfg.forcedbins_filename)
         if reference is not None:
             schema = reference
+            if len(keep) != int(schema.num_total_features):
+                Log.fatal("streaming ingest: file has %d feature columns but "
+                          "the reference dataset has %d", len(keep),
+                          int(schema.num_total_features))
         else:
-            schema = BinnedDataset.from_matrix(
-                sample[:, keep] if len(sample) else
-                np.zeros((0, len(keep))),
+            schema = BinnedDataset.schema_from_sample(
+                sample[:, keep] if len(sample) else np.zeros((0, len(keep))),
+                keys,
                 max_bin=int(cfg.max_bin),
                 min_data_in_bin=int(cfg.min_data_in_bin),
                 min_data_in_leaf=int(cfg.min_data_in_leaf),
-                bin_construct_sample_cnt=len(sample) or 1,
                 categorical_feature=cols.categorical,
                 use_missing=bool(cfg.use_missing),
                 zero_as_missing=bool(cfg.zero_as_missing),
-                data_random_seed=int(cfg.data_random_seed),
-                enable_bundle=bool(cfg.enable_bundle),
-                feature_names=feat_names, keep_raw=False,
-                forced_bins=forced_bins,
+                feature_names=feat_names, forced_bins=forced_bins,
                 max_bin_by_feature=(list(cfg.max_bin_by_feature)
-                                    if cfg.max_bin_by_feature else None))
+                                    if cfg.max_bin_by_feature else None),
+                enable_bundle=bool(cfg.enable_bundle))
 
         ds = BinnedDataset()
         ds.num_data = n_kept
@@ -461,7 +536,18 @@ class DatasetLoader:
         ds.bin_offset = schema.bin_offset
         ds.num_bin_per_group = list(schema.num_bin_per_group)
         ds.raw_data = None
+        if striped:
+            ds.shard = {"rank": int(rank), "num_machines": int(num_machines),
+                        "begin": int(begin), "end": int(end),
+                        "num_total": int(total_rows)}
+        if use_collective and reference is None:
+            # every rank must have frozen the SAME schema or the learners
+            # will exchange histograms over incompatible bin spaces —
+            # fail loudly now, not at iteration 40 (ROADMAP pod pin)
+            from ..parallel import distdata
+            distdata.verify_schema(ds, allgather, total_rows=total_rows)
 
+        # ---- pass 2: stream this rank's stripe, bin chunk-by-chunk ----
         max_nb = max(ds.num_bin_per_group, default=2)
         out_dtype = np.uint8 if max_nb <= 256 else np.uint16
         binned = np.zeros((n_kept, len(ds.feature_groups)), dtype=out_dtype)
@@ -471,18 +557,24 @@ class DatasetLoader:
         group_col = (np.zeros(n_kept, dtype=np.float64)
                      if group_idx >= 0 else None)
 
-        pos = 0       # global row cursor in the file
+        t1 = time.perf_counter()
+        stats = {"stall_s": 0.0}
+        prev_stall = 0.0
+        n_chunks = 0
         wpos = 0      # write cursor into the kept stripe
         for chunk in self._prefetch(
-                stream_file(filename, self._TWO_ROUND_CHUNK, header,
+                stream_file(filename, chunk_rows, header,
                             num_cols=(full_cols - 1 if fmt == "libsvm"
-                                      else None))):
-            m = chunk.shape[0]
-            lo, hi = max(begin - pos, 0), min(end - pos, m)
-            pos += m
-            if hi <= lo:
-                continue
-            part = chunk[lo:hi]
+                                      else None),
+                            skip_rows=begin, max_rows=n_kept),
+                depth, stats):
+            if resilience.preemption_requested():
+                # nothing durable is half-written: the binned store lives in
+                # RAM until save_binary's atomic rename after the last chunk
+                resilience.clear_preemption()
+                raise resilience.TrainingPreempted(0)
+            tc = time.perf_counter()
+            part = chunk
             k = part.shape[0]
             binned[wpos:wpos + k] = ds.bundle_rows(part[:, keep])
             label[wpos:wpos + k] = part[:, label_idx]
@@ -491,8 +583,35 @@ class DatasetLoader:
             if group_col is not None:
                 group_col[wpos:wpos + k] = part[:, group_idx]
             wpos += k
-        assert wpos == n_kept, (wpos, n_kept)
+            rss = hostmem.note()
+            if tele is not None:
+                dt = time.perf_counter() - tc
+                stall = stats["stall_s"] - prev_stall
+                prev_stall = stats["stall_s"]
+                tele.event("ingest", phase="bin", chunk=n_chunks, rows=int(k),
+                           dt_s=round(dt, 6), stall_s=round(stall, 6),
+                           rss_bytes=int(rss))
+                tele.counter("ingest_chunks").inc()
+                tele.counter("ingest_rows").inc(int(k))
+                tele.histogram("ingest_chunk_rows_per_s").observe(
+                    k / dt if dt > 0 else 0.0)
+            n_chunks += 1
+        if wpos != n_kept:
+            Log.fatal("streaming ingest: pass 2 delivered %d rows for a "
+                      "stripe of %d (file changed between passes?)",
+                      wpos, n_kept)
         ds.binned = binned
+        if tele is not None:
+            dt2 = time.perf_counter() - t1
+            tele.event("ingest", phase="done", chunks=int(n_chunks),
+                       rows=int(n_kept), dt_s=round(dt2, 6),
+                       rows_per_s=round(n_kept / dt2 if dt2 > 0 else 0.0, 1),
+                       stall_s=round(stats["stall_s"], 6),
+                       rss_high_water=int(hostmem.high_water()))
+            tele.gauge("host_rss_high_water_bytes").set(
+                float(hostmem.high_water()))
+            tele.gauge("ingest_stall_ms").set(
+                round(stats["stall_s"] * 1000.0, 3))
 
         ds.metadata = Metadata(n_kept)
         ds.metadata.set_label(label)

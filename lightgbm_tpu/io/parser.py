@@ -167,14 +167,18 @@ def sniff_header(path: str):
 
 def stream_file(path: str, chunk_rows: int = 65536,
                 header: "Optional[bool]" = None,
-                num_cols: "Optional[int]" = None):
+                num_cols: "Optional[int]" = None,
+                skip_rows: int = 0, max_rows: "Optional[int]" = None):
     """Yield [m, D] float64 chunks of a text data file (m <= chunk_rows).
 
     For CSV/TSV, D is the file's column count (label still embedded).  For
     LibSVM, the leading label is column 0 and features occupy columns
     1..num_cols (``num_cols`` from a prior sampling pass is required so
-    chunk widths agree)."""
+    chunk widths agree).  ``skip_rows``/``max_rows`` select a contiguous
+    data-row range (both count non-blank DATA lines, header excluded) —
+    the stripe window of a sharded pass 2."""
     fmt, sep = detect_format(path)
+    skip_rows = int(skip_rows)
     if fmt == "libsvm":
         if num_cols is None:
             raise ValueError("LibSVM streaming needs num_cols from the "
@@ -191,11 +195,18 @@ def stream_file(path: str, chunk_rows: int = 65536,
                         mat[r, i + 1] = v
             return mat
 
+        seen = 0
+        emitted = 0
         with open_file(path) as fh:
             for line in fh:
                 toks = line.split()
                 if not toks:
                     continue
+                seen += 1
+                if seen <= skip_rows:
+                    continue
+                if max_rows is not None and emitted >= max_rows:
+                    break
                 start = 0
                 lab = 0.0
                 if ":" not in toks[0]:
@@ -205,6 +216,7 @@ def stream_file(path: str, chunk_rows: int = 65536,
                 buf_rows.append([(int(t.split(":", 1)[0]),
                                   float(t.split(":", 1)[1]))
                                  for t in toks[start:] if ":" in t])
+                emitted += 1
                 if len(buf_rows) >= chunk_rows:
                     yield flush()
                     buf_rows, labels = [], []
@@ -214,32 +226,76 @@ def stream_file(path: str, chunk_rows: int = 65536,
 
     lines = _sniff_lines(path, 1)
     hdr = _has_header(lines[0], sep) if header is None else header
+    na = ["", "NA", "N/A", "nan", "NaN", "null"]
     try:
         import pandas as pd
         import contextlib
-        # registered schemes (hdfs:// etc.) go through open_file; plain local
-        # paths are handed to pandas directly so its C reader owns the file
-        src_cm = (open_file(path) if "://" in path
-                  else contextlib.nullcontext(path))
-        with src_cm as src:
-            reader = pd.read_csv(
-                src, sep=sep, header=0 if hdr else None,
-                dtype=np.float64 if not hdr else None,
-                na_values=["", "NA", "N/A", "nan", "NaN", "null"],
-                chunksize=chunk_rows)
-            for df in reader:
-                yield df.to_numpy(dtype=np.float64)
+        if skip_rows == 0 and max_rows is None:
+            # registered schemes (hdfs:// etc.) go through open_file; plain
+            # local paths are handed to pandas directly so its C reader owns
+            # the file
+            src_cm = (open_file(path) if "://" in path
+                      else contextlib.nullcontext(path))
+            with src_cm as src:
+                reader = pd.read_csv(
+                    src, sep=sep, header=0 if hdr else None,
+                    dtype=np.float64 if not hdr else None,
+                    na_values=na, chunksize=chunk_rows)
+                for df in reader:
+                    yield df.to_numpy(dtype=np.float64)
+            return
+        # stripe window: consume the header + skipped data lines by hand
+        # (blank-line discipline must match the counting scan), then let
+        # the C reader stream the remainder from the open handle
+        remaining = max_rows
+        if remaining is not None and remaining <= 0:
+            return
+        with open_file(path) as fh:
+            if hdr:
+                fh.readline()
+            skipped = 0
+            while skipped < skip_rows:
+                line = fh.readline()
+                if not line:
+                    return
+                if line.strip():
+                    skipped += 1
+            try:
+                reader = pd.read_csv(fh, sep=sep, header=None,
+                                     dtype=np.float64, na_values=na,
+                                     chunksize=chunk_rows)
+                for df in reader:
+                    a = df.to_numpy(dtype=np.float64)
+                    if remaining is not None:
+                        a = a[:remaining]
+                    if len(a):
+                        yield a
+                    if remaining is not None:
+                        remaining -= len(a)
+                        if remaining <= 0:
+                            break
+            except pd.errors.EmptyDataError:
+                return
+        return
     except ImportError:
         with open_file(path) as fh:
             if hdr:
                 fh.readline()
             rows = []
+            seen = 0
+            emitted = 0
             for line in fh:
                 line = line.strip()
                 if not line:
                     continue
+                seen += 1
+                if seen <= skip_rows:
+                    continue
+                if max_rows is not None and emitted >= max_rows:
+                    break
                 rows.append([float("nan") if t in _NA_TOKENS else float(t)
                              for t in line.split(sep)])
+                emitted += 1
                 if len(rows) >= chunk_rows:
                     yield np.asarray(rows, dtype=np.float64)
                     rows = []
@@ -356,3 +412,142 @@ def sample_stream(path: str, sample_cnt: int, seed: int = 1,
                   for t in line.strip().split(sep)] for line in line_sample],
                 dtype=np.float64)
         return mat, total, mat.shape[1]
+
+
+# ---- hash-priority sampling scan (round-21 streaming/sharded pass 1) -----
+
+
+def _iter_line_blocks(path: str, header: bool, skip_rows: int = 0,
+                      max_rows: "Optional[int]" = None):
+    """Yield ``(ordinal, lines)`` blocks of non-blank data lines: 16 MB raw
+    reads split in C, header + the first ``skip_rows`` data lines dropped,
+    at most ``max_rows`` lines emitted.  ``ordinal`` is the 0-based data-line
+    position of ``lines[0]`` WITHIN the emitted window (callers add their
+    stripe offset).  Shares sample_stream's line discipline (and its quoted-
+    newline limitation, same as the reference's line-based parser)."""
+    seen = 0      # non-blank data lines consumed, including skipped ones
+    emitted = 0
+    skip_rows = int(skip_rows)
+
+    def clip(lines):
+        nonlocal seen, emitted
+        drop = max(0, skip_rows - seen)
+        seen += len(lines)
+        kept = lines[drop:]
+        if max_rows is not None:
+            kept = kept[:max_rows - emitted]
+        start = emitted
+        emitted += len(kept)
+        return start, kept
+
+    with open_file(path) as fh:
+        if header:
+            fh.readline()
+        rem = ""
+        while True:
+            block = fh.read(16 << 20)
+            if not block:
+                break
+            block = rem + block
+            lines = block.split("\n")
+            rem = lines.pop()
+            lines = [l for l in lines if l.strip()]
+            if not lines:
+                continue
+            start, kept = clip(lines)
+            if kept:
+                yield start, kept
+            if max_rows is not None and emitted >= max_rows:
+                return
+        if rem.strip():
+            start, kept = clip([rem])
+            if kept:
+                yield start, kept
+
+
+def count_data_rows(path: str, header: "Optional[bool]" = None) -> int:
+    """Count non-blank data rows without parsing — pass 0 of the sharded
+    loader (every rank needs the global row count to know its stripe)."""
+    fmt, sep = detect_format(path)
+    if fmt == "libsvm":
+        hdr = False
+    elif header is None:
+        lines0 = _sniff_lines(path, 1)
+        hdr = _has_header(lines0[0], sep) if lines0 else False
+    else:
+        hdr = bool(header)
+    n = 0
+    for _start, lines in _iter_line_blocks(path, hdr):
+        n += len(lines)
+    return n
+
+
+def hash_sample_lines(path: str, sample_cnt: int, seed: int,
+                      header: "Optional[bool]" = None, skip_rows: int = 0,
+                      max_rows: "Optional[int]" = None,
+                      base_index: "Optional[int]" = None):
+    """Pass 1 of the streaming loader: scan RAW lines of (a stripe of) the
+    file, keep the :mod:`sample` hash-priority winners, and parse ONLY the
+    winners — sampling costs a line scan, never a full parse.
+
+    Rows are globally indexed ``base_index + ordinal`` (default
+    ``skip_rows``, i.e. a stripe of the same file), which is what makes a
+    striped scan's winners mergeable into the exact serial sample.
+    Returns ``(idx, keys, sample [k, D], rows_scanned, width)`` with the
+    sample ascending by global index; ``width`` counts ALL file columns —
+    for LibSVM the label column 0 plus ``max_feature_index + 1`` features.
+    """
+    from .sample import RowSampler
+    fmt, sep = detect_format(path)
+    if fmt == "libsvm":
+        hdr = False
+    elif header is None:
+        lines0 = _sniff_lines(path, 1)
+        hdr = _has_header(lines0[0], sep) if lines0 else False
+    else:
+        hdr = bool(header)
+    base = int(skip_rows) if base_index is None else int(base_index)
+    smp = RowSampler(sample_cnt, seed)
+    max_idx = -1
+    for start, lines in _iter_line_blocks(path, hdr, skip_rows, max_rows):
+        if fmt == "libsvm":
+            for line in lines:
+                for t in line.split():
+                    if ":" in t:
+                        i = int(t.split(":", 1)[0])
+                        if i > max_idx:
+                            max_idx = i
+        arr = np.empty(len(lines), dtype=object)
+        arr[:] = lines
+        smp.observe(np.arange(base + start, base + start + len(lines),
+                              dtype=np.int64), arr)
+    idx, keys, rows = smp.result()
+    win_lines = list(rows) if rows is not None else []
+    if fmt == "libsvm":
+        width = max_idx + 2  # label col 0 + features 1..max_idx+1
+        mat = np.zeros((len(win_lines), width), dtype=np.float64)
+        for r, line in enumerate(win_lines):
+            toks = line.split()
+            start0 = 0
+            if toks and ":" not in toks[0]:
+                mat[r, 0] = float(toks[0])
+                start0 = 1
+            for t in toks[start0:]:
+                if ":" in t:
+                    i, v = t.split(":", 1)
+                    mat[r, int(i) + 1] = float(v)
+        return idx, keys, mat, smp.total, width
+    if not win_lines:
+        return idx, keys, np.zeros((0, 0), dtype=np.float64), smp.total, 0
+    try:
+        import pandas as pd
+        df = pd.read_csv(io.StringIO("\n".join(win_lines)), sep=sep,
+                         header=None, dtype=np.float64,
+                         na_values=["", "NA", "N/A", "nan", "NaN", "null"])
+        mat = df.to_numpy(dtype=np.float64)
+    except ImportError:
+        mat = np.asarray(
+            [[float("nan") if t in _NA_TOKENS else float(t)
+              for t in line.strip().split(sep)] for line in win_lines],
+            dtype=np.float64)
+    return idx, keys, mat, smp.total, mat.shape[1]

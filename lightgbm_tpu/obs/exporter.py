@@ -134,7 +134,13 @@ def render_prometheus(snapshot: Dict[str, Any],
             continue
         n = _prom_name(name) + "_total"
         metric(n, "counter", ["%s %s" % (n, _prom_val(v))])
+    # host_rss_high_water_bytes mirrors the always-on hostmem gauge below
+    # (same dedup rule as the mirrored counters; the live read is fresher
+    # than the run gauge the loader last set)
+    mirrored_gauges = ("host_rss_high_water_bytes",)
     for name, v in sorted(snapshot.get("gauges", {}).items()):
+        if name in mirrored_gauges:
+            continue
         n = _prom_name(name)
         metric(n, "gauge", ["%s %s" % (n, _prom_val(v))])
     for name, h in sorted(snapshot.get("histograms", {}).items()):
@@ -175,6 +181,18 @@ def render_prometheus(snapshot: Dict[str, Any],
     from ..plan.cache import fallback_count as _plan_fallbacks
     pf = _PREFIX + "plan_cache_fallbacks_total"
     metric(pf, "counter", ["%s %d" % (pf, _plan_fallbacks())])
+    # host-memory plane (obs/hostmem.py, round 21): current RSS plus the
+    # high-water (max of the chunk-boundary polls and the kernel's VmHWM)
+    # — always-on like the resilience counters; the scrape IS the poll,
+    # so the bounded-memory claim of the streaming loader is scrapeable
+    # on any run, telemetry or not
+    from . import hostmem as _hostmem
+    hr = _PREFIX + "host_rss_bytes"
+    metric(hr, "gauge", ["%s %d" % (hr, _hostmem.note())])
+    hw = _PREFIX + "host_rss_high_water_bytes"
+    metric(hw, "gauge",
+           ["%s %d" % (hw, max(_hostmem.high_water(),
+                               _hostmem.peak_rss_bytes()))])
     # model-quality plane (obs/quality.py): labeled per-model gauges,
     # rendered only when the run monitors traffic (no stale exposition)
     models = (quality or {}).get("models") or {}

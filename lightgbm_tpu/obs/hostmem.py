@@ -1,0 +1,90 @@
+"""Host-memory tracking: always-on RSS + high-water readings.
+
+The host-side analog of :mod:`devmem` for the round-21 bounded-memory
+claim: the streaming loader's whole point is peak host RSS ~ O(chunk +
+sample + binned store), and a claim about memory that is not scrapeable
+is an assertion, not a property.  Readings come from ``/proc`` (Linux:
+``/proc/self/statm`` for current RSS, ``VmHWM`` in ``/proc/self/status``
+for the kernel's own high-water), with a ``resource.getrusage`` fallback
+elsewhere; each read is one small file read (~microseconds), cheap enough
+to poll at every ingest chunk boundary.
+
+Two high-water notions coexist on purpose:
+
+- :func:`peak_rss_bytes` — the OS-tracked lifetime peak (``VmHWM``),
+  what the bench harness compares across loaders;
+- :func:`note` / :func:`high_water` — the process-local observed peak
+  across explicit poll points, what the always-on gauge and per-chunk
+  ``ingest`` events report (it attributes the peak to a phase, which
+  ``VmHWM`` cannot).
+
+Telemetry-off cost is one file read per ``note`` call at chunk
+granularity; no thread, no timer.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+try:
+    _PAGE = os.sysconf("SC_PAGE_SIZE")
+except (AttributeError, ValueError, OSError):
+    _PAGE = 4096
+
+_LOCK = threading.Lock()
+_HIGH = 0
+
+
+def rss_bytes() -> int:
+    """Current resident set size in bytes (0 if unreadable)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        # ru_maxrss is a PEAK (kilobytes on Linux), not current — best
+        # effort on platforms without /proc
+        return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+    except Exception:
+        return 0
+
+
+def peak_rss_bytes() -> int:
+    """OS-tracked lifetime peak RSS (VmHWM) in bytes (0 if unreadable)."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+    except Exception:
+        return 0
+
+
+def note() -> int:
+    """Poll current RSS, fold it into the observed high-water, return it."""
+    global _HIGH
+    cur = rss_bytes()
+    if cur > _HIGH:
+        with _LOCK:
+            if cur > _HIGH:
+                _HIGH = cur
+    return cur
+
+
+def high_water() -> int:
+    """Largest RSS seen across :func:`note` calls this process."""
+    return _HIGH
+
+
+def reset_high_water() -> None:
+    """Restart the observed high-water (bench cells isolate phases)."""
+    global _HIGH
+    with _LOCK:
+        _HIGH = 0

@@ -164,6 +164,28 @@ def contrib_block(counters: Dict[str, Any], gauges: Dict[str, Any],
     }
 
 
+def ingest_block(counters: Dict[str, Any], gauges: Dict[str, Any],
+                 hists: Dict[str, Any]):
+    """Fold the streaming loader's metrics (round 21, io/loader.py
+    ``_load_streaming``) into one summary section: chunk/row counts, the
+    per-chunk binning throughput histogram, pipeline stall time (wall the
+    consumer spent waiting on the parse thread — the overlap the 2-deep
+    pipeline failed to hide) and the host RSS high-water that makes the
+    bounded-memory claim scrapeable.  None when the run never streamed.
+    Shared by :func:`summarize` and ``tools/obs_report.py``'s died-run
+    recovery."""
+    chunks = counters.get("ingest_chunks")
+    if not chunks:
+        return None
+    return {
+        "chunks": int(chunks),
+        "rows": int(counters.get("ingest_rows", 0)),
+        "rows_per_s": hists.get("ingest_chunk_rows_per_s", {"count": 0}),
+        "stall_ms": gauges.get("ingest_stall_ms"),
+        "rss_high_water_bytes": gauges.get("host_rss_high_water_bytes"),
+    }
+
+
 def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
               ) -> Dict[str, Any]:
     """Fold a run's registry + recompile counters into the summary dict."""
@@ -269,6 +291,12 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
     contrib = contrib_block(counters, gauges, hists)
     if contrib is not None:
         out["contrib"] = contrib
+    # streaming-ingest rollup (round 21, io/loader.py): chunks, binning
+    # throughput, pipeline stall and the host RSS high-water — present
+    # only when the run streamed its dataset
+    ingest = ingest_block(counters, gauges, hists)
+    if ingest is not None:
+        out["ingest"] = ingest
     # performance-forensics rollups (round 16), each present only when its
     # run-owned state exists: compile wall-seconds per (fn, bucket) — the
     # autotuner's ranking substrate — device-memory high-water, profiler
@@ -441,6 +469,22 @@ def human_table(summary: Dict[str, Any]) -> str:
                 row("    bucket %s" % bucket, "n=%d p50=%.6g p99=%.6g"
                     % (h["count"], h.get("p50", float("nan")),
                        h.get("p99", float("nan"))))
+    ing = summary.get("ingest") or {}
+    if ing:
+        lines.append("  ingest:")
+        rps = ing.get("rows_per_s") or {}
+        row("    chunks/rows", "%d/%d"
+            % (ing.get("chunks", 0), ing.get("rows", 0)))
+        if rps.get("count"):
+            row("    chunk rows/s", "p50=%.6g p99=%.6g"
+                % (rps.get("p50", float("nan")),
+                   rps.get("p99", float("nan"))))
+        row("    pipeline stall_ms",
+            "-" if ing.get("stall_ms") is None
+            else "%.3f" % ing["stall_ms"])
+        hw = ing.get("rss_high_water_bytes")
+        row("    host rss high-water",
+            "-" if hw is None else "%.1f MiB" % (hw / (1 << 20)))
     plan = summary.get("plan") or {}
     if plan:
         row("plan provenance", "%s (cache=%s, fallbacks=%d)"

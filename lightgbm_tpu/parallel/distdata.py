@@ -1,0 +1,101 @@
+"""Multi-host dataset assembly: pod rank resolution + schema agreement.
+
+The round-21 sharded ingest path (io/loader.py ``_load_streaming``) lets
+each host read only its row stripe and exchange O(sample_cnt) bin-finding
+candidates over one allgather.  That is only sound if every rank then
+freezes *identical* BinMappers and EFB groups — the learners in
+:mod:`learners` exchange histograms positionally, so a one-bin skew on one
+rank silently corrupts every split decision after it.  This module is the
+agreement layer:
+
+- :func:`pod_info` resolves ``(rank, num_machines)`` from the
+  ``jax.distributed`` runtime (the reference's ``Network::rank()`` /
+  ``num_machines()`` over its socket/MPI layer, which for us is the JAX
+  coordination service + ICI/DCN collectives);
+- :func:`schema_digest` extends ``checkpoint.dataset_fingerprint`` —
+  the mapper CRC every resume already trusts — with the EFB group layout
+  and the GLOBAL row count (shard-invariant: local ``num_data`` differs
+  per rank by construction and must not enter the digest);
+- :func:`verify_schema` allgathers the digest and fails loudly on the
+  first mismatch, at construction time rather than at iteration 40;
+- :func:`shard_of` / :func:`stripe_bounds` are the one place the
+  row-range convention (``n*r//d .. n*(r+1)//d``, matching the serial
+  loader's pre_partition stripes) is written down.
+
+Single-process runs degenerate exactly: ``pod_info() == (0, 1)``,
+``verify_schema`` with one payload compares a digest to itself, and the
+loader's output is byte-identical to the serial path (pinned in
+tests/test_stream_ingest.py).
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..checkpoint import mapper_digest
+from ..utils.log import Log
+
+
+def pod_info() -> Tuple[int, int]:
+    """``(rank, num_machines)`` of this process under ``jax.distributed``;
+    ``(0, 1)`` when JAX is single-process (or absent)."""
+    try:
+        import jax
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:  # jax missing/uninitialized: serial semantics
+        return 0, 1
+
+
+def stripe_bounds(num_total: int, rank: int,
+                  num_machines: int) -> Tuple[int, int]:
+    """Row range ``[begin, end)`` of ``rank`` — the same balanced-stripe
+    convention the serial loader uses for pre_partition=false
+    (dataset_loader.cpp:168), so serial concat == sharded union."""
+    num_total = int(num_total)
+    begin = num_total * int(rank) // int(num_machines)
+    end = num_total * (int(rank) + 1) // int(num_machines)
+    return begin, end
+
+
+def shard_of(ds) -> Optional[dict]:
+    """The shard stamp the loader leaves on a host-sharded store (None for
+    a whole-data store) — ``{rank, num_machines, begin, end, num_total}``."""
+    shard = getattr(ds, "shard", None)
+    return dict(shard) if shard else None
+
+
+def schema_digest(ds, total_rows: Optional[int] = None) -> str:
+    """Digest of everything two ranks must agree on before training: the
+    mapper set (``checkpoint.mapper_digest`` — the same CRC the resume
+    fingerprint trusts), the EFB group layout, and the GLOBAL row count.
+    Deliberately excludes local ``num_data``/shard bounds — those differ
+    per rank by design."""
+    crc = mapper_digest(ds.bin_mappers)
+    crc = zlib.crc32(np.asarray(
+        [int(ds.num_total_features),
+         int(total_rows if total_rows is not None else ds.num_data)],
+        dtype=np.int64).tobytes(), crc)
+    for g in ds.feature_groups:
+        crc = zlib.crc32(np.asarray([-1] + [int(f) for f in g],
+                                    dtype=np.int64).tobytes(), crc)
+    crc = zlib.crc32(np.asarray(ds.bin_offset,
+                                dtype=np.int64).tobytes(), crc)
+    return "%08x" % (crc & 0xFFFFFFFF)
+
+
+def verify_schema(ds, allgather_fn, total_rows: Optional[int] = None) -> str:
+    """Allgather :func:`schema_digest` across the pod and ``Log.fatal`` on
+    any divergence (rank list included — the operator's first question).
+    Returns the agreed digest."""
+    digest = schema_digest(ds, total_rows=total_rows)
+    parts = [p.decode() for p in allgather_fn(digest.encode())]
+    bad = [r for r, d in enumerate(parts) if d != parts[0]]
+    if bad:
+        Log.fatal("sharded ingest: schema digest mismatch across ranks "
+                  "(digests %s; disagreeing ranks %s) — all hosts must see "
+                  "the same file and config", parts, bad)
+    Log.info("sharded ingest: schema digest %s agreed across %d rank(s)",
+             digest, len(parts))
+    return digest

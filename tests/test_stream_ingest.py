@@ -1,0 +1,225 @@
+"""Round-21 streaming ingestion: the ``data_chunk_rows`` two-pass loader must
+be BYTE-identical to the one-shot path — same BinMapper dicts, same packed
+store — at every chunk-boundary alignment, for CSV files and CSR input, with
+EFB on and off, and through the 2-virtual-rank collective assembly (whose
+per-rank schema digests must agree and whose concatenated shards must train
+the same model as the serial loader's dataset)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.loader import DatasetLoader
+from lightgbm_tpu.parallel import distdata
+
+N_ROWS = 1000
+
+
+def _table(n=N_ROWS, seed=3):
+    """Dense table with a NaN-holed column and a low-cardinality column."""
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(n, 6)).round(4)
+    x[rng.rand(n) < 0.1, 1] = np.nan
+    x[:, 2] = rng.randint(0, 5, size=n)
+    y = (x[:, 0] + 0.5 * x[:, 2] + 0.1 * rng.normal(size=n)).round(4)
+    return x, y
+
+
+def _write_csv(path, x, y):
+    np.savetxt(path, np.column_stack([y, x]), fmt="%.6g", delimiter=",")
+    return str(path)
+
+
+def _cfg(**kw):
+    base = dict(max_bin=63, bin_construct_sample_cnt=200, verbosity=-1)
+    base.update(kw)
+    return Config(base)
+
+
+def _mappers(ds):
+    # json round-trip so the NaN-bin upper bound (NaN != NaN) compares equal
+    import json
+    return json.dumps([m.to_dict() for m in ds.bin_mappers], sort_keys=True)
+
+
+def _assert_same_dataset(a, b):
+    assert _mappers(a) == _mappers(b)
+    assert a.binned.dtype == b.binned.dtype
+    np.testing.assert_array_equal(a.binned, b.binned)
+    np.testing.assert_array_equal(np.asarray(a.metadata.label),
+                                  np.asarray(b.metadata.label))
+
+
+# ---- file path: streaming vs one-shot at chunk-boundary alignments ----
+
+@pytest.mark.parametrize("chunk_rows", [249, 250, 251])
+@pytest.mark.parametrize("bundle", [True, False])
+def test_csv_streaming_bit_identical_at_boundaries(tmp_path, chunk_rows,
+                                                   bundle):
+    # 250 divides 1000: chunk/chunk-1/chunk+1 hit the exact-boundary, final
+    # short-chunk and straddling-chunk layouts of pass 2
+    x, y = _table()
+    fname = _write_csv(tmp_path / "t.csv", x, y)
+    mem = DatasetLoader(_cfg(enable_bundle=bundle)).load_from_file(fname)
+    stream = DatasetLoader(
+        _cfg(enable_bundle=bundle,
+             data_chunk_rows=chunk_rows)).load_from_file(fname)
+    _assert_same_dataset(mem, stream)
+
+
+def test_csv_streaming_with_categorical_column(tmp_path):
+    x, y = _table()
+    fname = _write_csv(tmp_path / "t.csv", x, y)
+    cfgkw = dict(categorical_feature="2")
+    mem = DatasetLoader(_cfg(**cfgkw)).load_from_file(fname)
+    stream = DatasetLoader(
+        _cfg(data_chunk_rows=333, **cfgkw)).load_from_file(fname)
+    _assert_same_dataset(mem, stream)
+    from lightgbm_tpu.io.binning import BinType
+    assert any(m.bin_type == BinType.CATEGORICAL for m in stream.bin_mappers)
+
+
+def test_csv_streaming_depth_one_disables_overlap_not_results(tmp_path):
+    x, y = _table()
+    fname = _write_csv(tmp_path / "t.csv", x, y)
+    d1 = DatasetLoader(_cfg(data_chunk_rows=100,
+                            ingest_pipeline_depth=1)).load_from_file(fname)
+    d3 = DatasetLoader(_cfg(data_chunk_rows=100,
+                            ingest_pipeline_depth=3)).load_from_file(fname)
+    _assert_same_dataset(d1, d3)
+
+
+# ---- CSR path: windowed scatter vs one-shot ----
+
+@pytest.mark.parametrize("chunk_rows", [199, 200, 201])
+def test_csr_chunked_bit_identical(chunk_rows):
+    rng = np.random.RandomState(5)
+    n, f = 1000, 8
+    dense = rng.normal(size=(n, f)) * (rng.rand(n, f) < 0.3)
+    y = dense[:, 0] + 0.1 * rng.normal(size=n)
+    indptr = np.zeros(n + 1, np.int64)
+    indices, values = [], []
+    for i in range(n):
+        nz = np.nonzero(dense[i])[0]
+        indptr[i + 1] = indptr[i] + len(nz)
+        indices.extend(nz)
+        values.extend(dense[i, nz])
+    indices = np.asarray(indices, np.int32)
+    values = np.asarray(values)
+    one = BinnedDataset.from_csr(indptr, indices, values, f, label=y,
+                                 max_bin=63, bin_construct_sample_cnt=300)
+    chunked = BinnedDataset.from_csr(indptr, indices, values, f, label=y,
+                                     max_bin=63, bin_construct_sample_cnt=300,
+                                     data_chunk_rows=chunk_rows)
+    _assert_same_dataset(one, chunked)
+
+
+# ---- 2-virtual-rank collective assembly ----
+
+class _ThreadGather:
+    """Barrier allgather: both ranks run concurrently in threads; every
+    round, writes land before the first barrier, reads before the second."""
+
+    def __init__(self, world):
+        self.parts = [None] * world
+        self.barrier = threading.Barrier(world)
+
+    def for_rank(self, rank):
+        def gather(payload):
+            self.parts[rank] = payload
+            self.barrier.wait()
+            out = list(self.parts)
+            self.barrier.wait()
+            return out
+        return gather
+
+
+def _load_sharded(fname, world=2, **cfgkw):
+    gather = _ThreadGather(world)
+    shards, errs = [None] * world, []
+
+    def run(rank):
+        try:
+            loader = DatasetLoader(_cfg(data_chunk_rows=170, **cfgkw))
+            loader.allgather_fn = gather.for_rank(rank)
+            shards[rank] = loader.load_from_file(fname, rank, world)
+        except BaseException as exc:
+            errs.append((rank, exc))
+            gather.barrier.abort()
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    return shards
+
+
+def _train_model_string(ds):
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.objective import create_objective
+    cfg = Config(objective="regression", num_leaves=15, min_data_in_leaf=5,
+                 num_iterations=8, verbosity=-1, max_bin=63)
+    booster = create_boosting(cfg.boosting, cfg, ds,
+                              create_objective(cfg.objective, cfg))
+    booster.train()
+    return booster.save_model_to_string()
+
+
+def test_two_rank_assembly_matches_serial_and_trains_identically(tmp_path):
+    x, y = _table()
+    fname = _write_csv(tmp_path / "t.csv", x, y)
+    serial = DatasetLoader(_cfg(data_chunk_rows=170)).load_from_file(fname)
+    shards = _load_sharded(fname)
+
+    # every rank froze the same schema: digest pin across ranks
+    digests = [distdata.schema_digest(s, total_rows=serial.num_data)
+               for s in shards]
+    assert digests[0] == digests[1]
+    assert digests[0] == distdata.schema_digest(serial)
+
+    # shard stamps cover the stripe decomposition exactly
+    assert [s.shard["begin"] for s in shards] == [0, serial.num_data // 2]
+    assert sum(s.num_data for s in shards) == serial.num_data
+
+    # concatenated shard stores ARE the serial store
+    for s in shards:
+        assert _mappers(s) == _mappers(serial)
+    np.testing.assert_array_equal(
+        np.concatenate([s.binned for s in shards], axis=0), serial.binned)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(s.metadata.label) for s in shards]),
+        np.asarray(serial.metadata.label))
+
+    # the assembled dataset trains byte-identically to the serial one
+    merged = shards[0]
+    merged.binned = np.concatenate([s.binned for s in shards], axis=0)
+    merged.num_data = serial.num_data
+    merged.metadata.num_data = serial.num_data
+    merged.metadata.set_label(
+        np.concatenate([np.asarray(s.metadata.label) for s in shards]))
+    assert _train_model_string(merged) == _train_model_string(serial)
+
+
+def test_sharded_fingerprint_carries_shard_stamp(tmp_path):
+    from lightgbm_tpu.checkpoint import dataset_fingerprint
+    x, y = _table()
+    fname = _write_csv(tmp_path / "t.csv", x, y)
+    serial = DatasetLoader(_cfg(data_chunk_rows=170)).load_from_file(fname)
+    shards = _load_sharded(fname)
+    fp = dataset_fingerprint(shards[1])
+    assert fp["shard"]["rank"] == 1
+    assert fp["shard"]["num_machines"] == 2
+    assert fp["shard"]["num_total"] == serial.num_data
+    # unsharded fingerprints carry no shard block (digest stability pin)
+    assert "shard" not in dataset_fingerprint(serial)
+
+
+def test_chunked_with_pre_partition_rejected():
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError, match="pre_partition"):
+        _cfg(data_chunk_rows=100, pre_partition=True, num_machines=2)

@@ -69,6 +69,15 @@ Scenarios (each prints PASS/FAIL and exits nonzero on failure):
                that served it, and the rerun resumes the SAME cycle and
                publishes a next generation byte-identical (model hash)
                to an uninterrupted run's.
+  ingest-preempt  The round-21 streaming-loader drill: SIGTERM lands in
+               the middle of pass 2 of a ``data_chunk_rows`` ingest.  The
+               loader polls the preemption flag at the next chunk
+               boundary and the process exits EXIT_PREEMPTED (75) with NO
+               partial binary store on disk (``save_binary`` is a single
+               atomic rename after the last chunk); ingest holds no
+               checkpoint state, so recovery is the rerun — which
+               re-ingests from the raw file and trains a byte-identical
+               model (hash-pinned vs an uninterrupted run).
   stall-capture  The round-16 flight recorder under the hang drill: the
                watchdog stall, with a telemetry run and flight_recorder
                armed, emits a kind="alert" event, triggers EXACTLY ONE
@@ -658,6 +667,105 @@ def scenario_level_preempt(workdir: str) -> None:
         "level-mode preempted resume diverged from the uninterrupted run"
     print("PASS level-preempt: level-batched dispatch preempts at the chunk "
           "boundary and resumes bit-exact (resumed at iter %d)" % resumed)
+
+
+# ---- ingest-preempt: SIGTERM mid-pass-2 of the streaming loader ----
+
+_INGEST_CHILD_SRC = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import hashlib
+import signal
+import numpy as np
+from lightgbm_tpu import resilience
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io import parser as parser_mod
+from lightgbm_tpu.io.loader import DatasetLoader
+
+resilience.install_preemption_handler()
+sig_after = int(os.environ["SIG_AFTER_CHUNKS"])
+orig_stream = parser_mod.stream_file
+
+def stream(*a, **kw):
+    # SIGTERM lands after the Nth pass-2 chunk leaves the parser (possibly
+    # from the prefetch producer thread -- raise_signal still routes the
+    # Python-level handler to the main thread, whose flag the bin loop
+    # polls at the next chunk boundary)
+    n = 0
+    for chunk in orig_stream(*a, **kw):
+        yield chunk
+        n += 1
+        if sig_after and n == sig_after:
+            signal.raise_signal(signal.SIGTERM)
+
+parser_mod.stream_file = stream
+cfg = Config(objective="regression", num_leaves=15, min_data_in_leaf=5,
+             num_iterations=10, verbosity=-1, max_bin=63,
+             data_chunk_rows=int(os.environ["CHUNK_ROWS"]),
+             save_binary=True)
+loader = DatasetLoader(cfg)
+try:
+    ds = loader.load_from_file(os.environ["DATA_PATH"])
+except resilience.TrainingPreempted:
+    print("PREEMPTED-IN-INGEST")
+    sys.exit(resilience.EXIT_PREEMPTED)
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.metric.metric import create_metrics
+from lightgbm_tpu.objective import create_objective
+booster = create_boosting(cfg.boosting, cfg, ds,
+                          create_objective(cfg.objective, cfg))
+booster.add_train_metrics(create_metrics(cfg.metric, cfg))
+booster.train()
+sha = hashlib.sha256(booster.save_model_to_string().encode()).hexdigest()
+print("MODEL-SHA %s" % sha)
+print("INGESTED-AND-TRAINED")
+"""
+
+
+def scenario_ingest_preempt(workdir: str) -> None:
+    """SIGTERM mid-pass-2 of streaming ingest: exit EXIT_PREEMPTED with no
+    partial binary store on disk; the rerun re-ingests from the raw file and
+    trains bit-exact (ingest holds no checkpoint state -- recovery IS the
+    rerun, which is why the store write must be all-or-nothing)."""
+    import numpy as np
+    from lightgbm_tpu.resilience import EXIT_PREEMPTED
+    rng = np.random.RandomState(11)
+    n = 3000
+    x = rng.normal(size=(n, 8)).round(4)
+    y = (x[:, 0] - x[:, 1] + 0.1 * rng.normal(size=n)).round(4)
+    data = os.path.join(workdir, "ingest_train.csv")
+    np.savetxt(data, np.column_stack([y, x]), fmt="%.4f", delimiter=",")
+    env = {"DATA_PATH": data, "CHUNK_ROWS": "500"}
+
+    def model_sha(p):
+        return [ln for ln in p.stdout.splitlines()
+                if ln.startswith("MODEL-SHA")][0]
+
+    # reference: uninterrupted streaming ingest + train
+    p = _run_child(_INGEST_CHILD_SRC, dict(env, SIG_AFTER_CHUNKS="0"))
+    assert "INGESTED-AND-TRAINED" in p.stdout, p.stdout + p.stderr[-2000:]
+    ref = model_sha(p)
+    assert os.path.exists(data + ".bin"), "save_binary did not land"
+    os.remove(data + ".bin")
+
+    # preempt after 2 of 6 pass-2 chunks
+    p = _run_child(_INGEST_CHILD_SRC, dict(env, SIG_AFTER_CHUNKS="2"))
+    assert p.returncode == EXIT_PREEMPTED, \
+        "expected exit %d (resumable), got %r: %s" % (
+            EXIT_PREEMPTED, p.returncode, p.stdout + p.stderr[-2000:])
+    assert "PREEMPTED-IN-INGEST" in p.stdout
+    assert "INGESTED-AND-TRAINED" not in p.stdout
+    partial = [f for f in os.listdir(workdir) if ".bin" in f]
+    assert not partial, "partial binary store on disk: %r" % partial
+
+    # rerun re-ingests from the raw file; model is bit-exact vs the reference
+    p = _run_child(_INGEST_CHILD_SRC, dict(env, SIG_AFTER_CHUNKS="0"))
+    assert "INGESTED-AND-TRAINED" in p.stdout, p.stdout + p.stderr[-2000:]
+    assert model_sha(p) == ref, \
+        "post-preempt re-ingest trained a different model"
+    assert os.path.exists(data + ".bin")
+    print("PASS ingest-preempt: exit code %d mid-pass-2, no partial store; "
+          "re-ingest trains bit-exact" % EXIT_PREEMPTED)
 
 
 # ---- swap-under-load: hot-swap a resident model mid-traffic (round 13) ----
@@ -1557,6 +1665,7 @@ SCENARIOS = {"kill-write": scenario_kill_write,
              "swap-under-load": scenario_swap_under_load,
              "drift-swap": scenario_drift_swap,
              "level-preempt": scenario_level_preempt,
+             "ingest-preempt": scenario_ingest_preempt,
              "scrape-under-preempt": scenario_scrape_under_preempt,
              "corrupt": scenario_corrupt,
              "nan-grad": scenario_nan_grad,

@@ -184,6 +184,14 @@ def summary_from_events(events):
     # never summarized
     ctb_counters = {}
     ctb_hists = {}
+    # streaming-ingest recovery (round 21): kind="ingest" chunk events
+    # rebuild the ingest block.  In --merge pod mode this folds per-rank
+    # shards: chunks/rows/stall SUM across ranks, the RSS high-water is
+    # the MAX (each rank's reading describes its own host; the pod's
+    # headline number is the worst host)
+    ing_counters = {}
+    ing_gauges = {}
+    ing_hists = {}
     n_events = 0
     for e in events:
         n_events += 1
@@ -267,6 +275,33 @@ def summary_from_events(events):
                 and "contrib" in str(e.get("site", "")):
             ctb_counters["contrib_fallbacks"] = \
                 ctb_counters.get("contrib_fallbacks", 0) + 1
+        if e["kind"] == "ingest":
+            phase = e.get("phase")
+            if phase == "bin":
+                ing_counters["ingest_chunks"] = \
+                    ing_counters.get("ingest_chunks", 0) + 1
+                rows = int(e.get("rows", 0))
+                ing_counters["ingest_rows"] = \
+                    ing_counters.get("ingest_rows", 0) + rows
+                if isinstance(e.get("dt_s"), (int, float)) and e["dt_s"] > 0:
+                    ing_hists.setdefault("ingest_chunk_rows_per_s",
+                                         Histogram()).observe(
+                        rows / e["dt_s"])
+                if isinstance(e.get("stall_s"), (int, float)):
+                    # per-chunk deltas, so summing never double-counts the
+                    # cumulative total the phase="done" event also carries
+                    ing_gauges["ingest_stall_ms"] = (
+                        ing_gauges.get("ingest_stall_ms", 0.0)
+                        + e["stall_s"] * 1000.0)
+                if isinstance(e.get("rss_bytes"), (int, float)):
+                    ing_gauges["host_rss_high_water_bytes"] = max(
+                        int(ing_gauges.get("host_rss_high_water_bytes", 0)),
+                        int(e["rss_bytes"]))
+            elif phase == "done" \
+                    and isinstance(e.get("rss_high_water"), (int, float)):
+                ing_gauges["host_rss_high_water_bytes"] = max(
+                    int(ing_gauges.get("host_rss_high_water_bytes", 0)),
+                    int(e["rss_high_water"]))
         if e["kind"] == "serve_batch" and e.get("contrib"):
             ctb_counters["serve_contrib_requests"] = \
                 ctb_counters.get("serve_contrib_requests", 0) \
@@ -365,13 +400,18 @@ def summary_from_events(events):
             q_models[m] = entry
     quality = ({"models": q_models, "generations": q_gens}
                if q_models else None)
-    from lightgbm_tpu.obs.report import contrib_block, online_block
+    from lightgbm_tpu.obs.report import (contrib_block, ingest_block,
+                                         online_block)
     online = online_block(onl_counters, onl_gauges,
                           {k: h.summary() for k, h in onl_hists.items()})
     contrib = contrib_block(ctb_counters, {},
                             {k: h.summary() for k, h in ctb_hists.items()})
     if contrib is not None:
         contrib["recovered"] = True
+    ingest = ingest_block(ing_counters, ing_gauges,
+                          {k: h.summary() for k, h in ing_hists.items()})
+    if ingest is not None:
+        ingest["recovered"] = True
     compile_block = None
     if compile_keys:
         compile_block = {
@@ -409,6 +449,7 @@ def summary_from_events(events):
         **({"quality": quality} if quality else {}),
         **({"online": online} if online else {}),
         **({"contrib": contrib} if contrib else {}),
+        **({"ingest": ingest} if ingest else {}),
         **({"compile": compile_block} if compile_block else {}),
         **({"alerts": alerts_block} if alerts_block else {}),
         **({"plan": plan_block} if plan_block else {}),

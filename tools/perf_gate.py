@@ -97,6 +97,8 @@ def sniff(doc: dict) -> str:
         return "autotune"
     if doc.get("metric") == "precision_tiers":
         return "precision"
+    if doc.get("metric") == "ingest_stream":
+        return "ingest"
     if "grid" in doc and "dropped" in doc:
         return "serve"
     if "level" in doc or ("points" in doc and "fits" in doc):
@@ -319,6 +321,37 @@ def gate_precision(g: Gate, path: str, doc: dict, b: dict) -> None:
         g.skip(path, "precision budgets", "no lossy cells in artifact")
 
 
+def gate_ingest(g: Gate, path: str, doc: dict, b: dict) -> None:
+    """BENCH_ingest artifact (tools/bench_ingest.py): the streaming loader
+    must be bit-identical to the one-shot path, match the serial store under
+    2-virtual-rank sharded assembly, and buy its bounded RSS without giving
+    back more throughput than the declared factor."""
+    g.check(path, "ingest bit-identical digests",
+            doc.get("bit_identical") is True,
+            "streaming sha256(mappers+store+label) == in-memory, all cells")
+    g.check(path, "ingest sharded assembly matches serial",
+            doc.get("sharded_digest_match") is True,
+            str(doc.get("sharded_error",
+                        "2-rank schema digests agree, concat store == serial")))
+    ceil = b.get("ingest_rss_ratio_max")
+    if ceil is not None and doc.get("rss_ratio") is not None:
+        g.check(path, "ingest streaming peak-RSS ratio",
+                float(doc["rss_ratio"]) <= float(ceil),
+                "%.3f <= %.3f" % (float(doc["rss_ratio"]), float(ceil)))
+    else:
+        g.skip(path, "ingest streaming peak-RSS ratio",
+               "no ingest_rss_ratio_max budget or ratio in artifact")
+    floor = b.get("ingest_rows_per_s_factor_min")
+    if floor is not None and doc.get("rows_per_s_factor") is not None:
+        g.check(path, "ingest streaming rows/s factor",
+                float(doc["rows_per_s_factor"]) >= float(floor),
+                "%.3f >= %.3f" % (float(doc["rows_per_s_factor"]),
+                                  float(floor)))
+    else:
+        g.skip(path, "ingest streaming rows/s factor",
+               "no ingest_rows_per_s_factor_min budget or factor in artifact")
+
+
 def gate_bench_line(g: Gate, path: str, doc: dict, b: dict) -> None:
     if "recompiles_steady" in doc:
         g.check(path, "recompiles steady",
@@ -472,6 +505,8 @@ def run_gate(artifacts, budgets_path: str) -> int:
             gate_autotune(g, path, doc, b)
         elif kind == "precision":
             gate_precision(g, path, doc, b)
+        elif kind == "ingest":
+            gate_ingest(g, path, doc, b)
         elif kind == "bench_line":
             gate_bench_line(g, path, doc, b)
         else:
