@@ -704,7 +704,8 @@ class GBDT:
                     hk = hk * self.bag_mask
                 with FunctionTimer("TreeLearner::Train(dispatch)"):
                     arrays = self.learner.train(gk, hk, self.bag_data_cnt,
-                                                feature_mask)
+                                                feature_mask,
+                                                iteration=self.iter_)
                 rate = self.shrinkage_rate
                 scaled = arrays._replace(
                     leaf_value=arrays.leaf_value * rate,
@@ -840,6 +841,8 @@ class GBDT:
                       bucket_plan=learner.bucket_plan,
                       pallas_interpret=learner.pallas_interpret,
                       tree_grow_mode=learner.effective_grow_mode(),
+                      hist_precision=learner.hist_precision,
+                      quant_seed=learner.quant_seed,
                       carried=True)
 
         def f32col(rows, off):
@@ -880,7 +883,8 @@ class GBDT:
                     nd_it = nd
                 arr, rows = build_tree_partitioned(
                     bins, g[:ntot], h[:ntot], nd_it, fm, feat,
-                    rows_carry=rows, score_rate=jnp.float32(rate), **kwargs)
+                    rows_carry=rows, score_rate=jnp.float32(rate),
+                    quant_it=it, **kwargs)
                 arr = arr._replace(
                     leaf_value=arr.leaf_value * rate,
                     internal_value=arr.internal_value * rate)
@@ -897,6 +901,9 @@ class GBDT:
             # store construction (leaf values stay 0, score unchanged)
             init_kwargs = dict(kwargs)
             init_kwargs["num_leaves"] = 1
+            # the store-construction no-op build never looks at gradients
+            # (all zero); keep it on the exact path
+            init_kwargs["hist_precision"] = "exact"
             zero = jnp.zeros((ntot,), jnp.float32)
             _, rows0 = build_tree_partitioned(
                 bins, zero, zero, nd, fm, feat,
@@ -941,7 +948,9 @@ class GBDT:
                       hist_pool_slots=learner.hist_pool_slots,
                       bucket_plan=learner.bucket_plan,
                       pallas_interpret=learner.pallas_interpret,
-                      tree_grow_mode=learner.effective_grow_mode())
+                      tree_grow_mode=learner.effective_grow_mode(),
+                      hist_precision=learner.hist_precision,
+                      quant_seed=learner.quant_seed)
 
         bag = self._fused_bag()
         bag_seed = int(self.config.bagging_seed)
@@ -969,7 +978,7 @@ class GBDT:
                     gk = jnp.pad(g[kk], (0, pad))
                     hk = jnp.pad(h[kk], (0, pad))
                     arr = build_tree_partitioned(bins, gk, hk, nd_it, fm,
-                                                 feat, **kwargs)
+                                                 feat, quant_it=it, **kwargs)
                     arr = arr._replace(
                         leaf_value=arr.leaf_value * rate,
                         internal_value=arr.internal_value * rate)
@@ -1132,6 +1141,26 @@ class GBDT:
                                                int(learner.num_bins)),
                               mode=str(getattr(learner, "tree_grow_mode",
                                                "leaf")))
+        # round-22 quantized-gradient training: the quant path's static
+        # facts ride each chunk as counters/gauges + one raw event, so a
+        # died run's JSONL still carries the whole quant block (the
+        # summary writer may never run); exact runs emit NOTHING here
+        if learner is not None and getattr(learner, "hist_precision",
+                                           "exact") == "quantized":
+            from ..core.histogram import _hist_channels
+            from ..core.quant import GRAD_LEVELS, HESS_LEVELS
+            tele.counter("quant_chunks").inc()
+            tele.counter("quant_iters").inc(int(iters))
+            tele.gauge("quant_grad_levels").set(GRAD_LEVELS)
+            tele.gauge("quant_hess_levels").set(HESS_LEVELS)
+            tele.gauge("quant_hist_channels").set(_hist_channels(True))
+            tele.event("quant", first_iter=int(first_iter),
+                       iters=int(iters), grad_levels=int(GRAD_LEVELS),
+                       hess_levels=int(HESS_LEVELS),
+                       hist_channels=int(_hist_channels(True)),
+                       exact_channels=int(_hist_channels(False)),
+                       collective_dtype=("bfloat16" if getattr(
+                           learner, "comm", None) is not None else ""))
         # HBM high-water stamp per chunk (obs/devmem.py): import-safe,
         # quietly empty on backends without memory_stats
         _devmem.sample(tele, phase="train_chunk")
@@ -1181,7 +1210,8 @@ class GBDT:
                     hk = hk * self.bag_mask
                 with FunctionTimer("TreeLearner::Train"):
                     arrays = self.learner.train(gk, hk, self.bag_data_cnt,
-                                                feature_mask)
+                                                feature_mask,
+                                                iteration=self.iter_)
                 nl = int(arrays.num_leaves)
                 if nl > 1:
                     new_tree = self.learner.host_tree(arrays)

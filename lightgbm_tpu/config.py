@@ -266,6 +266,11 @@ class Config:
         if self.tree_grow_mode not in ("leaf", "level"):
             Log.fatal("Unknown tree_grow_mode %s (expected leaf or level)",
                       self.tree_grow_mode)
+        # round-22 quantized-gradient training axis
+        self.hist_precision = str(self.hist_precision).lower()
+        if self.hist_precision not in ("exact", "quantized"):
+            Log.fatal("Unknown hist_precision %s (expected exact or "
+                      "quantized)", self.hist_precision)
         # round-13 serving params: the coalescing window is a LATENCY the
         # operator adds to every request — a window past one second is
         # almost certainly a unit mistake (us, not ms/s)

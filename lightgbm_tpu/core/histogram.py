@@ -94,13 +94,20 @@ def _padded_features(num_features: int, num_bins: int) -> int:
     return -(-num_features // fp) * fp
 
 
-def _hilo_split(vals, axis, exact: bool = False):
+def _hilo_split(vals, axis, exact: bool = False, quantized: bool = False):
     """f32 -> (hi, lo) bf16 concatenated on ``axis``: bf16 products against a
     0/1 one-hot are exact and hi+lo recovers ~f32 precision (relative error
     ~2^-16) in a single MXU pass instead of the 6-pass f32 emulation.
 
     ``exact``: keep f32 and pad with zeros (the contraction then runs at
-    HIGHEST precision — see :func:`_exact_hist`)."""
+    HIGHEST precision — see :func:`_exact_hist`).
+
+    ``quantized`` (round 22): the values are already small integers
+    (core/quant.py stochastic rounding, |v| <= 255) — exact in bf16, so the
+    lo rows and the hi+lo fold disappear: the operand keeps its 2 rows and
+    the MXU pass runs at HALF the rows of the hi/lo split."""
+    if quantized:
+        return vals.astype(jnp.bfloat16)
     if exact:
         return jnp.concatenate([vals, jnp.zeros_like(vals)], axis=axis)
     hi = vals.astype(jnp.bfloat16)
@@ -333,16 +340,27 @@ def _hilo_factors(num_bins: int):
     return num_bins // nlo, nlo
 
 
-def _factored_geometry(num_features: int, num_bins: int):
+def _hist_channels(quantized: bool = False) -> int:
+    """Value rows per histogram operand: 4 for the bf16 hi/lo split
+    (grad_hi, hess_hi, grad_lo, hess_lo — also the exact-f32 layout, zero
+    padded), 2 for quantized integer gradients (no lo rows)."""
+    return 2 if quantized else 4
+
+
+def _factored_geometry(num_features: int, num_bins: int,
+                       quantized: bool = False):
     """(p, G): features per MXU group and group count.  Each group's left
-    operand stacks p features' value-weighted hi one-hots as [p*4*nhi = 128,
-    R]; the right stacks their lo one-hots [p*nlo, R]."""
+    operand stacks p features' value-weighted hi one-hots as
+    [p*nch*nhi = 128, R] (nch = 4, or 2 quantized — the integer operand
+    packs TWICE the features per group); the right stacks their lo
+    one-hots [p*nlo, R]."""
     nhi, _ = _hilo_factors(num_bins)
-    p = max(1, _LANE // (4 * nhi))
+    p = max(1, _LANE // (_hist_channels(quantized) * nhi))
     return p, -(-num_features // p)
 
 
-def _use_factored(num_features: int, num_bins: int) -> bool:
+def _use_factored(num_features: int, num_bins: int,
+                  quantized: bool = False) -> bool:
     """Factored vs classic packed-tile histogram.
 
     The classic one-hot costs ~2.5 VPU lane-ops per (row, feature, bin) —
@@ -366,16 +384,19 @@ def _use_factored(num_features: int, num_bins: int) -> bool:
         return override
     if num_bins < 32:
         return False
-    out = _factored_out_shape(num_features, num_bins)
+    out = _factored_out_shape(num_features, num_bins, quantized)
     # budget keyed by the ATTACHED device (memoized probe) so the gate
-    # agrees with the budget analytic_plan records into Plan/artifacts
+    # agrees with the budget analytic_plan records into Plan/artifacts.
+    # Quantized accumulators have HALF the rows, so twice the feature
+    # width passes the same budget (round 22).
     budget = _device_specs.hist_accum_budget_bytes(
         _device_specs.current_device_kind())
     return out[0] * out[1] * 4 <= budget
 
 
 def _accum_factored_group(ti_bf, v4T, out_ref, g, *, num_features: int,
-                          num_bins: int, bpc: int, packed: bool, f_base=0):
+                          num_bins: int, bpc: int, packed: bool, f_base=0,
+                          quantized: bool = False):
     """ONE feature group's factored-MXU histogram accumulation, with the
     group index ``g`` a TRACED scalar — the building block both of the
     grid-over-groups standalone kernel (g = pl.program_id) and of the fused
@@ -394,7 +415,8 @@ def _accum_factored_group(ti_bf, v4T, out_ref, g, *, num_features: int,
     [p*4*nhi, R] @ [R, p*nlo] contraction whose p x p feature cross-blocks
     are discarded except the diagonal (see _fold_factored)."""
     nhi, nlo = _hilo_factors(num_bins)
-    p, _ = _factored_geometry(num_features, num_bins)
+    p, _ = _factored_geometry(num_features, num_bins, quantized)
+    nch = _hist_channels(quantized)
     exact = v4T.dtype == jnp.float32
     oh_t = v4T.dtype
     W = ti_bf.shape[1]
@@ -442,10 +464,10 @@ def _accum_factored_group(ti_bf, v4T, out_ref, g, *, num_features: int,
         lo_oh = (colf & (nlo - 1)) == iota_lo  # to zero contribution
         hi_oh = jnp.where(valid, hi_oh, False).astype(oh_t)   # [nhi, R]
         lo_oh = jnp.where(valid, lo_oh, False).astype(oh_t)   # [nlo, R]
-        for c in range(4):
+        for c in range(nch):
             a_blocks.append(v4T[c:c + 1, :] * hi_oh)
         lo_blocks.append(lo_oh)
-    a_big = jnp.concatenate(a_blocks, axis=0)              # [p*4*nhi, R]
+    a_big = jnp.concatenate(a_blocks, axis=0)              # [p*nch*nhi, R]
     lo_big = jnp.concatenate(lo_blocks, axis=0)            # [p*nlo, R]
     acc = jax.lax.dot_general(
         a_big, lo_big, (((1,), (1,)), ((), ())),
@@ -458,40 +480,50 @@ def _accum_factored_group(ti_bf, v4T, out_ref, g, *, num_features: int,
 
 
 def _accum_factored_all(ti_bf, v4T, out_ref, *, num_features: int,
-                        num_bins: int, bpc: int, packed: bool, f_base=0):
+                        num_bins: int, bpc: int, packed: bool, f_base=0,
+                        quantized: bool = False):
     """Rolled loop over every feature group (the fused partition kernel's
     in-kernel histogram; the standalone kernel puts groups on the grid)."""
-    _, G = _factored_geometry(num_features, num_bins)
+    _, G = _factored_geometry(num_features, num_bins, quantized)
 
     def body(g, _):
         _accum_factored_group(ti_bf, v4T, out_ref, g,
                               num_features=num_features, num_bins=num_bins,
-                              bpc=bpc, packed=packed, f_base=f_base)
+                              bpc=bpc, packed=packed, f_base=f_base,
+                              quantized=quantized)
         return 0
 
     jax.lax.fori_loop(0, G, body, 0)
 
 
-def _fold_factored(raw, num_features: int, num_bins: int):
+def _fold_factored(raw, num_features: int, num_bins: int,
+                   quantized: bool = False):
     """[G*128, p*nlo] factored accumulator -> [F, 2, B] f32 (grad = hi + lo
-    value channels, hess likewise; bin = hi * nlo + lo)."""
+    value channels, hess likewise; bin = hi * nlo + lo).  Quantized
+    accumulators already carry exactly the 2 (grad, hess) integer channels —
+    no fold, just the diagonal gather."""
     nhi, nlo = _hilo_factors(num_bins)
-    p, G = _factored_geometry(num_features, num_bins)
-    d = raw.reshape(G, p, 4, nhi, p, nlo)
+    p, G = _factored_geometry(num_features, num_bins, quantized)
+    nch = _hist_channels(quantized)
+    d = raw.reshape(G, p, nch, nhi, p, nlo)
     idx = jnp.arange(p)
-    diag = d[:, idx, :, :, idx, :]          # [p, G, 4, nhi, nlo]
-    h = diag.transpose(1, 0, 2, 3, 4).reshape(G * p, 4, nhi * nlo)
+    diag = d[:, idx, :, :, idx, :]          # [p, G, nch, nhi, nlo]
+    h = diag.transpose(1, 0, 2, 3, 4).reshape(G * p, nch, nhi * nlo)
     h = h[:num_features]
+    if quantized:
+        return h
     return h[:, 0:2, :] + h[:, 2:4, :]
 
 
-def _factored_out_shape(num_features: int, num_bins: int):
+def _factored_out_shape(num_features: int, num_bins: int,
+                        quantized: bool = False):
     nhi, nlo = _hilo_factors(num_bins)
-    p, G = _factored_geometry(num_features, num_bins)
-    return (G * p * 4 * nhi, p * nlo)
+    p, G = _factored_geometry(num_features, num_bins, quantized)
+    return (G * p * _hist_channels(quantized) * nhi, p * nlo)
 
 
-def _extract_values_T(ti_bf, *, voff: int, exact: bool, inwT=None):
+def _extract_values_T(ti_bf, *, voff: int, exact: bool, inwT=None,
+                      quantized: bool = False):
     """Transposed g/h extraction from a [R, W] bf16 row-store tile: ONE
     [4, W] @ [R, W]^T dot pulls the four 16-bit halves, the f32s are rebuilt
     via i32 OR (the wrap restores the sign bit; the OBVIOUS shifted-slice OR
@@ -519,6 +551,10 @@ def _extract_values_T(ti_bf, *, voff: int, exact: bool, inwT=None):
     if inwT is not None:
         g_w = g_w * inwT
         h_w = h_w * inwT
+    if quantized:
+        # integer-valued f32 (core/quant.py, |v| <= 255): exact in bf16,
+        # no lo rows — the 2-row operand of the halved MXU pass
+        return jnp.concatenate([g_w, h_w], axis=0).astype(jnp.bfloat16)
     if exact:
         return jnp.concatenate(
             [g_w, h_w, jnp.zeros_like(g_w), jnp.zeros_like(h_w)], axis=0)
@@ -552,7 +588,7 @@ def _f32_from_bytes(ti, off: int):
 def _hist_kernel_rows(win_ref, rows_ref, out_ref, w_sc, v4_sc, *,
                       num_features: int, num_bins: int, row_tile: int,
                       packed: bool, voff: int, bpc: int,
-                      exact: bool = False):
+                      exact: bool = False, quantized: bool = False):
     """Combined-row-store histogram, classic packed tiles, GRID over lane
     tiles: grid = (row tiles, output tiles).  ``rows`` is [Nt, W] u8 with
     bin codes in bytes [0, num_cols*bpc), grad/hess f32 little-endian at
@@ -591,7 +627,8 @@ def _hist_kernel_rows(win_ref, rows_ref, out_ref, w_sc, v4_sc, *,
         g = jnp.where(in_w, _f32_from_bytes(w, voff), zero)
         h = jnp.where(in_w, _f32_from_bytes(w, voff + 4), zero)
         vals = jnp.concatenate([g, h], axis=1)           # [Nt, 2] f32
-        v4_sc[...] = _hilo_split(vals, axis=1, exact=exact)  # [Nt, 4]
+        v4_sc[...] = _hilo_split(vals, axis=1, exact=exact,
+                                 quantized=quantized)    # [Nt, 4|2]
 
     @pl.when(active)
     def _accum():
@@ -608,7 +645,7 @@ def _hist_kernel_rows(win_ref, rows_ref, out_ref, w_sc, v4_sc, *,
 def _hist_kernel_rows_fac(win_ref, rows_ref, out_ref, tib_sc, v4_sc, *,
                           num_features: int, num_bins: int, row_tile: int,
                           packed: bool, voff: int, bpc: int,
-                          exact: bool = False):
+                          exact: bool = False, quantized: bool = False):
     """Factored-MXU variant of _hist_kernel_rows, GRID over feature groups:
     grid = (row tiles, G), one [p*4*nhi, R] @ [R, p*nlo] group block per
     step (see _accum_factored_group).  out_ref: [G*128, p*nlo] f32 — fold
@@ -633,24 +670,27 @@ def _hist_kernel_rows_fac(win_ref, rows_ref, out_ref, tib_sc, v4_sc, *,
         inwT = ((posT >= start).astype(jnp.float32)
                 * (posT < start + count).astype(jnp.float32))
         v4_sc[...] = _extract_values_T(tib_sc[...], voff=voff, exact=exact,
-                                       inwT=inwT)
+                                       inwT=inwT, quantized=quantized)
 
     @pl.when(active)
     def _accum():
         _accum_factored_group(tib_sc[...], v4_sc[...], out_ref, g,
                               num_features=num_features, num_bins=num_bins,
-                              bpc=bpc, packed=packed, f_base=win_ref[2])
+                              bpc=bpc, packed=packed, f_base=win_ref[2],
+                              quantized=quantized)
 
 
 @functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
                                              "voff", "bpc", "row_tile",
-                                             "packed", "interpret", "exact"))
+                                             "packed", "interpret", "exact",
+                                             "quantized"))
 def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
                           count: jax.Array, *, num_features: int, voff: int,
                           bpc: int = 1, packed: bool = False,
                           row_tile: int = 2048,
                           interpret: bool = False,
                           exact: bool = False,
+                          quantized: bool = False,
                           f_begin=0) -> jax.Array:
     """Histogram over rows [start, start+count) of a combined row store.
 
@@ -663,14 +703,17 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
     assert _LANE % num_bins == 0 or num_bins % _LANE == 0, (
         "num_bins must divide or be a multiple of 128 (use _pad_bins_pow2); "
         "got %d" % num_bins)
+    assert not (exact and quantized), \
+        "hist_precision=quantized is incompatible with LIGHTGBM_TPU_EXACT_HIST"
     # a feature window is only honored by the factored kernel; the classic
     # fallback would silently histogram columns [0, F) mislabeled as the
     # window, so reject the combination here rather than in a distant caller
-    assert _use_factored(num_features, num_bins) or (
+    assert _use_factored(num_features, num_bins, quantized) or (
         isinstance(f_begin, int) and f_begin == 0), \
         "f_begin needs the factored histogram path"
     win = jnp.stack([start.astype(jnp.int32), count.astype(jnp.int32),
                      jnp.asarray(f_begin, jnp.int32)])
+    nch = _hist_channels(quantized)
     v4_dtype = jnp.float32 if exact else jnp.bfloat16
 
     def _in_idx(i, g, win_ref):
@@ -680,13 +723,13 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
                   & ((i + 1) * row_tile > win_ref[0]))
         return (jnp.where(active, i, 0), 0)
 
-    if _use_factored(num_features, num_bins):
-        out_shape = _factored_out_shape(num_features, num_bins)
-        _, G = _factored_geometry(num_features, num_bins)
+    if _use_factored(num_features, num_bins, quantized):
+        out_shape = _factored_out_shape(num_features, num_bins, quantized)
+        _, G = _factored_geometry(num_features, num_bins, quantized)
         kernel = functools.partial(
             _hist_kernel_rows_fac, num_features=num_features,
             num_bins=num_bins, row_tile=row_tile, packed=packed, voff=voff,
-            bpc=bpc, exact=exact)
+            bpc=bpc, exact=exact, quantized=quantized)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n // row_tile, G),
@@ -694,7 +737,7 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
             out_specs=pl.BlockSpec(out_shape, lambda i, g, w: (0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((row_tile, width), jnp.bfloat16),  # staged tile
-                pltpu.VMEM((4, row_tile), v4_dtype),          # v4T values
+                pltpu.VMEM((nch, row_tile), v4_dtype),        # v4T values
             ],
         )
         raw = pl.pallas_call(
@@ -703,7 +746,7 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
             out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
             interpret=interpret,
         )(win, rows)
-        return _fold_factored(raw, num_features, num_bins)
+        return _fold_factored(raw, num_features, num_bins, quantized)
 
     # classic path: in practice only wide-F shapes land here (kernel bin
     # widths are padded to >= 32, so every narrow-F accumulator passes the
@@ -717,24 +760,24 @@ def histogram_pallas_rows(rows: jax.Array, num_bins: int, start: jax.Array,
     kernel = functools.partial(_hist_kernel_rows, num_features=num_features,
                                num_bins=num_bins, row_tile=row_tile,
                                packed=packed, voff=voff, bpc=bpc,
-                               exact=exact)
+                               exact=exact, quantized=quantized)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n // row_tile, lanes // _LANE),
         in_specs=[pl.BlockSpec((row_tile, width), _in_idx)],
-        out_specs=pl.BlockSpec((4, lanes), lambda i, t, w: (0, 0)),
+        out_specs=pl.BlockSpec((nch, lanes), lambda i, t, w: (0, 0)),
         scratch_shapes=[
             pltpu.VMEM((row_tile, width), jnp.bfloat16),      # staged tile
-            pltpu.VMEM((row_tile, 4), v4_dtype),              # hi/lo values
+            pltpu.VMEM((row_tile, nch), v4_dtype),            # hi/lo values
         ],
     )
     raw = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((4, lanes), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((nch, lanes), jnp.float32),
         interpret=interpret,
     )(win, rows)
-    folded = raw[0:2] + raw[2:4]
+    folded = raw[0:2] if quantized else raw[0:2] + raw[2:4]
     return folded.reshape(2, f_pad, num_bins).transpose(1, 0, 2)[:num_features]
 
 
@@ -765,13 +808,18 @@ def histogram_rows(rows: jax.Array, num_bins: int, start, count, *,
                    num_features: int, voff: int, bpc: int = 1,
                    packed: bool = False,
                    use_pallas: bool | None = None,
-                   f_begin=0, interpret: bool = False) -> jax.Array:
+                   f_begin=0, interpret: bool = False,
+                   quantized: bool = False) -> jax.Array:
     """Masked histogram over a combined row store; Pallas on TPU.
 
     ``f_begin``: feature-window base (may be traced) — feature-parallel
     shards histogram only columns [f_begin, f_begin + num_features).
     ``interpret``: run the Pallas path in interpret mode (CPU tests of the
-    fused builder)."""
+    fused builder).
+    ``quantized``: the stored grad/hess are integer-valued (core/quant.py)
+    — the Pallas kernels run the 2-row integer operand; the XLA fallback
+    needs no change (an f32 segment-sum of small integers is exact), so
+    both return the same exact integer sums."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas and rows.shape[0] % 2048 == 0:
@@ -779,6 +827,7 @@ def histogram_rows(rows: jax.Array, num_bins: int, start, count, *,
                                      num_features=num_features, voff=voff,
                                      bpc=bpc, packed=packed,
                                      exact=_exact_hist(), f_begin=f_begin,
+                                     quantized=quantized,
                                      interpret=interpret)
     if isinstance(f_begin, int) and f_begin == 0:
         bins, values = rows_split_xla(rows, num_features, voff, bpc, packed)

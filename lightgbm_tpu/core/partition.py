@@ -252,7 +252,8 @@ def _subtile_prefixes(S_L, S_R, ltri, *, nsub):
 
 
 def _hist_tile(ti_c, hist_ref, scal_ref, start, cnt, *, num_features,
-               num_bins, bpc, packed, exact, voff, f_shard):
+               num_bins, bpc, packed, exact, voff, f_shard,
+               quantized=False):
     """One [R, W] i32 row-store tile's histogram += contribution for the
     rows at TILE-RELATIVE positions [start, start + cnt) — the shared
     accumulation op of the streamed hist pass, the small-window kernel and
@@ -261,7 +262,7 @@ def _hist_tile(ti_c, hist_ref, scal_ref, start, cnt, *, num_features,
     accumulated value is independent of the tile height R up to fp-identity
     adds)."""
     rows_n = ti_c.shape[0]
-    if _use_factored(num_features, num_bins):
+    if _use_factored(num_features, num_bins, quantized):
         # rolled fori_loop over feature groups (round 6): program size is
         # O(p) in F, so wide-F row stores compile instead of unrolling
         # hundreds of groups
@@ -270,10 +271,12 @@ def _hist_tile(ti_c, hist_ref, scal_ref, start, cnt, *, num_features,
         inwT = ((posT >= start).astype(jnp.float32)
                 * (posT < start + cnt).astype(jnp.float32))
         fb = (scal_ref[12 + num_bins // 32] if f_shard else 0)
-        v4T = _extract_values_T(ti_bf_h, voff=voff, exact=exact, inwT=inwT)
+        v4T = _extract_values_T(ti_bf_h, voff=voff, exact=exact, inwT=inwT,
+                                quantized=quantized)
         _accum_factored_all(ti_bf_h, v4T, hist_ref,
                             num_features=num_features, num_bins=num_bins,
-                            bpc=bpc, packed=packed, f_base=fb)
+                            bpc=bpc, packed=packed, f_base=fb,
+                            quantized=quantized)
         return
     # classic fallback (accumulators past the factored 4 MiB gate, i.e.
     # wide F): rolled fori_loop over lane tiles with dynamic-index column
@@ -297,7 +300,7 @@ def _hist_tile(ti_c, hist_ref, scal_ref, start, cnt, *, num_features,
     inw = ((pos >= start).astype(jnp.float32)
            * (pos < start + cnt).astype(jnp.float32))
     vals = jnp.concatenate([g * inw, h * inw], axis=1)
-    v4 = _hilo_split(vals, axis=1, exact=exact)
+    v4 = _hilo_split(vals, axis=1, exact=exact, quantized=quantized)
     colf = _colf_rows_dyn(ti_c, bpc=bpc, packed=packed)
     _accum_onehot_all(colf, v4, hist_ref, num_features=num_features,
                       num_bins=num_bins, contract_dim=0)
@@ -305,7 +308,7 @@ def _hist_tile(ti_c, hist_ref, scal_ref, start, cnt, *, num_features,
 
 def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                            packed, exact, f_shard=False, dbg_skip="",
-                           chunk=CHUNK, multiwin=False):
+                           chunk=CHUNK, multiwin=False, quantized=False):
     # f_shard: the histogrammed feature window starts at scal[12 + B//32]
     # (feature-parallel shards build only their own F/d block while routing
     # on the full row store); num_features is then the WINDOW's width
@@ -730,7 +733,8 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
                                head - c * chunk, cnt,
                                num_features=num_features, num_bins=num_bins,
                                bpc=bpc, packed=packed, exact=exact,
-                               voff=voff, f_shard=f_shard)
+                               voff=voff, f_shard=f_shard,
+                               quantized=quantized)
                     return 0
 
                 jax.lax.fori_loop(0, nh, hbody, 0)
@@ -857,7 +861,8 @@ def _make_partition_kernel(*, n_pad, W, num_features, num_bins, voff, bpc,
 
 def _make_small_partition_kernel(*, n_pad, W, num_features, num_bins, voff,
                                  bpc, packed, exact, f_shard=False,
-                                 dbg_skip="", sc=SMALL_CHUNK, multiwin=False):
+                                 dbg_skip="", sc=SMALL_CHUNK, multiwin=False,
+                                 quantized=False):
     """Round-7 small-window variant: the whole window fits ONE ``sc``-row
     chunk (dispatch bound: wc <= sc - _ALIGN), so the entire streaming
     apparatus disappears — no input ring, no flush rings, no deferred phase
@@ -979,7 +984,7 @@ def _make_small_partition_kernel(*, n_pad, W, num_features, num_bins, voff,
                 _hist_tile(ti_c, hist_ref, scal, start, cnt,
                            num_features=num_features, num_bins=num_bins,
                            bpc=bpc, packed=packed, exact=exact, voff=voff,
-                           f_shard=f_shard)
+                           f_shard=f_shard, quantized=quantized)
 
             # ---- single write-back DMA ----
             cpo = pltpu.make_async_copy(outbuf,
@@ -993,13 +998,14 @@ def _make_small_partition_kernel(*, n_pad, W, num_features, num_bins, voff,
 
 @functools.partial(jax.jit, static_argnames=(
     "num_features", "num_bins", "voff", "bpc", "packed", "exact", "interpret",
-    "dbg_skip", "chunk", "small"))
+    "dbg_skip", "chunk", "small", "quantized"))
 def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
                           *, num_features: int,
                           num_bins: int, voff: int, bpc: int = 1,
                           packed: bool = False, exact: bool = False,
                           interpret: bool = False, dbg_skip: str = "",
-                          chunk: int = CHUNK, small: bool = False):
+                          chunk: int = CHUNK, small: bool = False,
+                          quantized: bool = False):
     """Fused split pass over a combined row store.
 
     ``dbg_skip``: comma-joined phase knockouts for device profiling only
@@ -1038,11 +1044,13 @@ def partition_hist_pallas(rows: jax.Array, scal: jax.Array,
     return _partition_call(rows, scal, num_features=num_features,
                            num_bins=num_bins, voff=voff, bpc=bpc,
                            packed=packed, exact=exact, interpret=interpret,
-                           dbg_skip=dbg_skip, chunk=chunk, small=small)
+                           dbg_skip=dbg_skip, chunk=chunk, small=small,
+                           quantized=quantized)
 
 
 def _partition_call(rows, scal, *, num_features, num_bins, voff, bpc,
-                    packed, exact, interpret, dbg_skip, chunk, small):
+                    packed, exact, interpret, dbg_skip, chunk, small,
+                    quantized=False):
     """Shared pallas_call plumbing for the single-window
     (:func:`partition_hist_pallas`, ``scal`` 1-D) and multi-window
     (:func:`partition_hist_level_pallas`, ``scal`` [G, S]) launches: the
@@ -1062,19 +1070,23 @@ def _partition_call(rows, scal, *, num_features, num_bins, voff, bpc,
         "num_bins must be the >=32 kernel-block width (_pad_bins_pow2); " \
         "nibble-packed 16-bin data still scans at 32 lanes"
     f_shard = scal_width == 13 + num_bins // 32
-    if _use_factored(num_features, num_bins):
-        hist_shape = _factored_out_shape(num_features, num_bins)
+    assert not (exact and quantized), \
+        "hist_precision=quantized is incompatible with LIGHTGBM_TPU_EXACT_HIST"
+    if _use_factored(num_features, num_bins, quantized):
+        hist_shape = _factored_out_shape(num_features, num_bins, quantized)
     else:
         assert not f_shard, \
             "the histogram feature window needs the factored path"
-        hist_shape = (4, _padded_features(num_features, num_bins) * num_bins)
+        hist_shape = (2 if quantized else 4,
+                      _padded_features(num_features, num_bins) * num_bins)
     h0, h1 = hist_shape
 
     if small:
         kernel = _make_small_partition_kernel(
             n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
             voff=voff, bpc=bpc, packed=packed, exact=exact, f_shard=f_shard,
-            dbg_skip=dbg_skip, sc=chunk, multiwin=multiwin)
+            dbg_skip=dbg_skip, sc=chunk, multiwin=multiwin,
+            quantized=quantized)
         rows_new, hist, nl = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -1113,7 +1125,8 @@ def _partition_call(rows, scal, *, num_features, num_bins, voff, bpc,
     kernel = _make_partition_kernel(
         n_pad=n_pad, W=W, num_features=num_features, num_bins=num_bins,
         voff=voff, bpc=bpc, packed=packed, exact=exact, f_shard=f_shard,
-        dbg_skip=dbg_skip, chunk=chunk, multiwin=multiwin)
+        dbg_skip=dbg_skip, chunk=chunk, multiwin=multiwin,
+        quantized=quantized)
     rows_new, _scratch, hist, nl = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -1174,13 +1187,14 @@ def level_plan(n: int) -> tuple:
 
 @functools.partial(jax.jit, static_argnames=(
     "num_features", "num_bins", "voff", "bpc", "packed", "exact", "interpret",
-    "chunk", "small"))
+    "chunk", "small", "quantized"))
 def partition_hist_level_pallas(rows: jax.Array, scals: jax.Array,
                                 *, num_features: int, num_bins: int,
                                 voff: int, bpc: int = 1,
                                 packed: bool = False, exact: bool = False,
                                 interpret: bool = False,
-                                chunk: int = CHUNK, small: bool = False):
+                                chunk: int = CHUNK, small: bool = False,
+                                quantized: bool = False):
     """Multi-window fused split pass: ONE Pallas launch partitions + child-
     histograms every window of ``scals`` ([G, S] — one
     :func:`partition_hist_pallas` scalar row per window, same layout).
@@ -1200,17 +1214,18 @@ def partition_hist_level_pallas(rows: jax.Array, scals: jax.Array,
     return _partition_call(rows, scals, num_features=num_features,
                            num_bins=num_bins, voff=voff, bpc=bpc,
                            packed=packed, exact=exact, interpret=interpret,
-                           dbg_skip="", chunk=chunk, small=small)
+                           dbg_skip="", chunk=chunk, small=small,
+                           quantized=quantized)
 
 
 def fold_hist(hist_raw: jax.Array, num_features: int,
-              num_bins: int) -> jax.Array:
+              num_bins: int, quantized: bool = False) -> jax.Array:
     """Kernel histogram accumulator -> [F, 2, B] f32 (factored or classic
     layout, matching partition_hist_pallas's choice)."""
-    if _use_factored(num_features, num_bins):
-        return _fold_factored(hist_raw, num_features, num_bins)
+    if _use_factored(num_features, num_bins, quantized):
+        return _fold_factored(hist_raw, num_features, num_bins, quantized)
     f_pad = _padded_features(num_features, num_bins)
-    folded = hist_raw[0:2] + hist_raw[2:4]
+    folded = hist_raw[0:2] if quantized else hist_raw[0:2] + hist_raw[2:4]
     return folded.reshape(2, f_pad, num_bins).transpose(1, 0, 2)[:num_features]
 
 

@@ -39,6 +39,19 @@ K_EPSILON = 1e-15  # meta.h:51
 K_MIN_SCORE = -jnp.inf
 
 
+def dequantize_hist(hist: jax.Array, qscale: jax.Array) -> jax.Array:
+    """Rescale an integer-valued quantized histogram back to real sums.
+
+    ``hist[..., 2, B]`` holds per-bin integer sums (channel 0 = grad,
+    channel 1 = hess) accumulated from quantized gradients; ``qscale`` is
+    the ``(s_g, s_h)`` pair from ``quant.quantize_gradients``.  Works for
+    any leading layout — ``[F, 2, B]``, ``[G, F, 2, B]``, or the
+    psum_scatter-sharded ``[F/d, 2, B]`` — because the channel axis is
+    always second-to-last.  Split-gain math downstream (this module) then
+    runs on real-valued sums unchanged."""
+    return hist * qscale.reshape((1,) * (hist.ndim - 2) + (2, 1))
+
+
 class SplitParams(NamedTuple):
     """Static (trace-time) learner hyperparameters."""
     lambda_l1: float = 0.0

@@ -38,9 +38,11 @@ from .histogram import (build_histogram, histogram_rows, pack_nibbles,
                         _pad_bins_pow2, _use_factored)
 from .partition import (CHUNK as _PCHUNK, fold_hist, fused_bucket_plan,
                         partition_hist_level_pallas, partition_hist_pallas)
+from .quant import quantize_gradients
 from .split import (BestSplit, FeatureInfo, SplitParams, best_split_numerical,
-                    per_feature_best, per_feature_best_combined,
-                    reduce_feature_best, sync_best, K_MIN_SCORE)
+                    dequantize_hist, per_feature_best,
+                    per_feature_best_combined, reduce_feature_best, sync_best,
+                    K_MIN_SCORE)
 from .tree import Tree
 from ..io.binning import BinType, MissingType
 from ..io.dataset import BinnedDataset
@@ -207,7 +209,7 @@ def _ffill_pair(flag: jax.Array, val: jax.Array):
                      "feat_num_bins", "packed_cols", "axis_name",
                      "comm_mode", "num_shards", "carried", "top_k",
                      "hist_pool_slots", "bucket_plan", "pallas_interpret",
-                     "tree_grow_mode"))
+                     "tree_grow_mode", "hist_precision"))
 def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            num_data: jax.Array, feature_mask: jax.Array,
                            feat: FeatureInfo, *, num_leaves: int,
@@ -228,6 +230,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            bucket_plan=None,
                            pallas_interpret: bool = False,
                            tree_grow_mode: str = "leaf",
+                           hist_precision: str = "exact",
+                           quant_it=None, quant_seed=0,
                            rows_carry=None, extra=None, score_rate=None):
     """Leaf-wise growth with per-leaf physical row partitions.
 
@@ -318,6 +322,38 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # CHUNK of rows past every window end, appended with valid unique
     # order bytes so the final row_leaf reconstruction scatter stays 1:1.
     fused = use_pallas and not lazy_on and n % _PCHUNK == 0
+    # ---- round 22: quantized-gradient training (hist_precision) ----
+    # Stochastically round grad/hess to small integers BEFORE the row-store
+    # byte pack, so every histogram consumer — the standalone row kernels,
+    # the fused split kernels' phase B, and the XLA fallback — reads
+    # integer-valued f32 automatically.  The rounding offset is a stateless
+    # hash of (iteration, ORIGINAL row id, seed): the same determinism
+    # contract as the bagging mask, so checkpoint resume and fused
+    # chunk-boundary replay see bit-identical integers, and a contiguously
+    # row-sharded build (global ids + pmax'd scales) quantizes the exact
+    # serial stream.
+    quantized = hist_precision == "quantized"
+    if hist_precision not in ("exact", "quantized"):
+        raise ValueError("unknown hist_precision %r" % (hist_precision,))
+    qscale = None
+    if quantized:
+        it_q = (jnp.asarray(quant_it, jnp.int32) if quant_it is not None
+                else jnp.int32(0))
+        if rows_carry is not None:
+            # carried mode: grad/hess arrive in the PERMUTED row order; key
+            # the stream by the original ids riding the store's order bytes
+            rid = jax.lax.bitcast_convert_type(
+                rows_carry[:n, voff + 8:voff + 12], jnp.int32)
+        else:
+            rid = jnp.arange(n, dtype=jnp.int32)
+        if axis_name and comm_mode != "feature":
+            # contiguous row sharding: shard s holds global rows
+            # [s*n, (s+1)*n); feature mode replicates rows, so local ids
+            # ARE global there
+            rid = rid + jax.lax.axis_index(axis_name) * n
+        grad, hess, qscale = quantize_gradients(
+            grad, hess, rid, it_q, quant_seed,
+            axis_name=axis_name if comm_mode != "feature" else "")
     if rows_carry is not None:
         # boosting state already lives (permuted) in the store; refresh only
         # the gradient/hessian bytes for this iteration
@@ -373,7 +409,8 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               num_features=hist_fc, voff=voff, bpc=bpc,
                               packed=bool(packed_cols),
                               use_pallas=use_pallas, f_begin=hist_f0,
-                              interpret=pallas_interpret)
+                              interpret=pallas_interpret,
+                              quantized=quantized)
 
     def col_from_rows(wi, gcol):
         """Dynamic bin-column extract from [R, W] i32 row-store bytes."""
@@ -444,13 +481,31 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     hist_fc, hist_f0 = f_cols, 0
     if feat_mode and (not use_pallas or _use_factored(f // num_shards,
-                                                      num_bins)):
+                                                      num_bins, quantized)):
         # shard histogram CONSTRUCTION, not just the scan; the TPU kernel
         # needs the factored path for a dynamic feature window, so wide-F
         # configurations keep the replicated build (scan still sharded)
         hist_fc, hist_f0 = chunk_f, off_f
 
     def reduce_hist(h):
+        if quantized:
+            # round 22: the collective payload rides bf16 — HALF the bytes
+            # of the f32 allreduce (int16 cannot hold the ~2^27 per-shard
+            # bin sums; bf16 never overflows and its rounding is charged to
+            # the declared quant budgets).  EVERY branch then dequantizes by
+            # the iteration's scales, so all stored histogram state
+            # (subtraction trick, FixHistogram, split scans) stays
+            # real-valued f32 and downstream code is unchanged.
+            if axis_name and not feat_mode and not vote_mode:
+                hb = h.astype(jnp.bfloat16)
+                if rs:
+                    hb = jax.lax.psum_scatter(hb, axis_name,
+                                              scatter_dimension=0,
+                                              tiled=True)
+                else:
+                    hb = jax.lax.psum(hb, axis_name)
+                h = hb.astype(jnp.float32)
+            return dequantize_hist(h, qscale)
         if not axis_name or feat_mode or vote_mode:
             # feature: rows replicated, local histogram IS global;
             # voting: histograms stay local, only elected rows are summed
@@ -482,7 +537,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     rows_m, scal_v, num_features=hist_fc, num_bins=num_bins,
                     voff=voff, bpc=bpc, packed=bool(packed_cols),
                     exact=_exact_hist(), chunk=chunk_k, small=small_k,
-                    interpret=pallas_interpret)
+                    interpret=pallas_interpret, quantized=quantized)
             return br
 
         fused_branches = [_mk_fused(s, c) for (s, c, _) in plan]
@@ -708,14 +763,19 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     hist0 = hist_rows(rows0, jnp.int32(0), jnp.int32(n))
     sum_g = jnp.sum(grad)
     sum_h = jnp.sum(hess)
-    if axis_name:
-        # root aggregate + histogram Allreduce/ReduceScatter
-        # (data_parallel_tree_learner.cpp:99-146); feature mode replicates
-        # the rows, so local sums are already global
-        hist0 = reduce_hist(hist0)
-        if not feat_mode:
-            sum_g = jax.lax.psum(sum_g, axis_name)
-            sum_h = jax.lax.psum(sum_h, axis_name)
+    # reduce_hist also DEQUANTIZES under hist_precision=quantized, so it
+    # runs unconditionally (identity for the serial exact path)
+    hist0 = reduce_hist(hist0)
+    if axis_name and not feat_mode:
+        # root aggregate Allreduce (data_parallel_tree_learner.cpp:99-146);
+        # feature mode replicates the rows, so local sums are already global
+        sum_g = jax.lax.psum(sum_g, axis_name)
+        sum_h = jax.lax.psum(sum_h, axis_name)
+    if quantized:
+        # root totals were summed over the INTEGER gradients: scale them
+        # back so leaf outputs / gains live in the real-valued domain
+        sum_g = sum_g * qscale[0]
+        sum_h = sum_h * qscale[1]
     no_min = jnp.float32(-np.inf)
     no_max = jnp.float32(np.inf)
     used0 = (cegb[2] if cegb is not None else jnp.zeros((f,), bool))
@@ -850,7 +910,7 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     [scal, jnp.reshape(jnp.asarray(hist_f0, jnp.int32),
                                        (1,))])
             rows_new, hist4, nl_arr = _fused_split(st.rows, scal, wc)
-            hist_small = fold_hist(hist4, hist_fc, num_bins)
+            hist_small = fold_hist(hist4, hist_fc, num_bins, quantized)
             nl = nl_arr[0, 0]
             used_l = used_r = jnp.zeros((f,), f32)
         else:
@@ -864,14 +924,14 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             else:
                 rows_new, hist_small, nl = branch_out
                 used_l = used_r = jnp.zeros((f,), f32)
-        if axis_name:
-            # per-split Allreduce (psum) or ReduceScatter (rs) of the
-            # smaller child's histogram
-            # (data_parallel_tree_learner.cpp:161 ReduceScatter)
-            hist_small = reduce_hist(hist_small)
-            if lazy_on:
-                used_l = jax.lax.psum(used_l, axis_name)
-                used_r = jax.lax.psum(used_r, axis_name)
+        # per-split Allreduce (psum) or ReduceScatter (rs) of the smaller
+        # child's histogram (data_parallel_tree_learner.cpp:161
+        # ReduceScatter); unconditional so the quantized path dequantizes
+        # on the serial learner too
+        hist_small = reduce_hist(hist_small)
+        if axis_name and lazy_on:
+            used_l = jax.lax.psum(used_l, axis_name)
+            used_r = jax.lax.psum(used_r, axis_name)
 
         def sel(new, old):
             """Masked state write: keep ``old`` on dead iterations."""
@@ -1137,11 +1197,16 @@ def build_tree_partitioned(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 rows_m, scal_c, num_features=hist_fc, num_bins=num_bins,
                 voff=voff, bpc=bpc, packed=bool(packed_cols),
                 exact=_exact_hist(), chunk=chunk_k, small=small_k,
-                interpret=pallas_interpret)
+                interpret=pallas_interpret, quantized=quantized)
             nl = nl + nl_c[:, 0]
             hist_raw = hist_c if hist_raw is None else hist_raw + hist_c
         hist_small = jax.vmap(
-            lambda h: fold_hist(h, hist_fc, num_bins))(hist_raw)
+            lambda h: fold_hist(h, hist_fc, num_bins, quantized))(hist_raw)
+        if quantized:
+            # level mode is serial-only (grow_level asserts no axis_name),
+            # so no collective rides here — dequantize the folded integers
+            # directly; st.hist and the subtraction trick stay real f32
+            hist_small = dequantize_hist(hist_small, qscale)
 
         # ---- subtraction trick + child best-split search, batched ----
         parent_hist = st.hist[lsafe]
@@ -1495,6 +1560,13 @@ class SerialTreeLearner:
         self.tree_grow_mode = str(getattr(config, "tree_grow_mode", "leaf")
                                   or "leaf")
         self._grow_mode_warned = False
+        # round-22 quantized-gradient training (hist_precision=quantized):
+        # static axis of the build; the stochastic-rounding stream is keyed
+        # by (seed, iteration, original row id) — stateless like bagging,
+        # so resume/replay is bit-exact without RNG state in the checkpoint
+        self.hist_precision = str(getattr(config, "hist_precision", "exact")
+                                  or "exact")
+        self.quant_seed = int(getattr(config, "seed", 0) or 0)
         self.grouped = bool(dataset.is_bundled and self.supports_groups)
         # histogram (kernel) width is the MXU-friendly power of two; the
         # per-feature scan width stays lane-padded only when group columns
@@ -1694,7 +1766,8 @@ class SerialTreeLearner:
             self.plan = _plan_state.resolve(
                 n, int(self.dataset.num_features), int(self.num_bins),
                 bpc=bpc, packed=bool(self.packed_cols),
-                num_class=int(getattr(self.config, "num_class", 1) or 1))
+                num_class=int(getattr(self.config, "num_class", 1) or 1),
+                quantized=self.hist_precision == "quantized")
             if self.plan.provenance != "analytic" \
                     and self.bucket_plan is None:
                 ladder = (self.plan.level_ladder
@@ -1775,9 +1848,12 @@ class SerialTreeLearner:
         return self.num_leaves - 1
 
     def train(self, grad: jax.Array, hess: jax.Array,
-              num_data_in_bag, feature_mask: Optional[jax.Array] = None
-              ) -> TreeArrays:
-        """grad/hess: [N] f32 already weighted/bagged (padded rows zero)."""
+              num_data_in_bag, feature_mask: Optional[jax.Array] = None,
+              iteration=0) -> TreeArrays:
+        """grad/hess: [N] f32 already weighted/bagged (padded rows zero).
+
+        ``iteration`` keys the quantized path's stochastic-rounding hash
+        (ignored under hist_precision=exact); a traced or host scalar."""
         if feature_mask is None:
             feature_mask = jnp.ones((self.dataset.num_features,), dtype=bool)
         grad = self.pad_rows(grad)
@@ -1838,7 +1914,10 @@ class SerialTreeLearner:
                 hist_pool_slots=self.hist_pool_slots,
                 bucket_plan=self.bucket_plan,
                 pallas_interpret=self.pallas_interpret,
-                tree_grow_mode=grow_mode)
+                tree_grow_mode=grow_mode,
+                hist_precision=self.hist_precision,
+                quant_it=jnp.asarray(iteration, jnp.int32),
+                quant_seed=self.quant_seed)
         if lazy_active:
             # per-(row, feature) paid bits live for the whole training
             # (feature_used_in_data_)

@@ -186,6 +186,27 @@ def ingest_block(counters: Dict[str, Any], gauges: Dict[str, Any],
     }
 
 
+def quant_block(counters: Dict[str, Any], gauges: Dict[str, Any],
+                hists: Dict[str, Any]):
+    """Fold the quantized-gradient training facts (round 22,
+    core/quant.py) into one summary section: how many chunks/iterations
+    rode the integer-histogram path and its static geometry (grad/hess
+    levels, 2-row operand channels).  None when the run trained exact.
+    Shared by :func:`summarize` and ``tools/obs_report.py``'s died-run
+    recovery."""
+    chunks = counters.get("quant_chunks")
+    if not chunks:
+        return None
+    del hists  # symmetry with the sibling *_block helpers
+    return {
+        "chunks": int(chunks),
+        "iterations": int(counters.get("quant_iters", 0)),
+        "grad_levels": gauges.get("quant_grad_levels"),
+        "hess_levels": gauges.get("quant_hess_levels"),
+        "hist_channels": gauges.get("quant_hist_channels"),
+    }
+
+
 def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
               ) -> Dict[str, Any]:
     """Fold a run's registry + recompile counters into the summary dict."""
@@ -297,6 +318,11 @@ def summarize(tele: Telemetry, extra: Optional[Dict[str, Any]] = None
     ingest = ingest_block(counters, gauges, hists)
     if ingest is not None:
         out["ingest"] = ingest
+    # quantized-training rollup (round 22, core/quant.py): present only
+    # when the run trained with hist_precision=quantized
+    quant = quant_block(counters, gauges, hists)
+    if quant is not None:
+        out["quant"] = quant
     # performance-forensics rollups (round 16), each present only when its
     # run-owned state exists: compile wall-seconds per (fn, bucket) — the
     # autotuner's ranking substrate — device-memory high-water, profiler
@@ -485,6 +511,19 @@ def human_table(summary: Dict[str, Any]) -> str:
         hw = ing.get("rss_high_water_bytes")
         row("    host rss high-water",
             "-" if hw is None else "%.1f MiB" % (hw / (1 << 20)))
+    qnt = summary.get("quant") or {}
+    if qnt:
+        lines.append("  quant:")
+        row("    chunks/iterations", "%d/%d"
+            % (qnt.get("chunks", 0), qnt.get("iterations", 0)))
+        row("    levels (grad/hess)", "%s/%s"
+            % (num(qnt.get("grad_levels"), "%d")
+               if qnt.get("grad_levels") is not None else "-",
+               num(qnt.get("hess_levels"), "%d")
+               if qnt.get("hess_levels") is not None else "-"))
+        row("    hist operand channels",
+            "-" if qnt.get("hist_channels") is None
+            else "%d" % qnt["hist_channels"])
     plan = summary.get("plan") or {}
     if plan:
         row("plan provenance", "%s (cache=%s, fallbacks=%d)"

@@ -376,7 +376,7 @@ class _ParallelTreeLearner(SerialTreeLearner):
     comm_mode = "rs"
 
     def _make_build_fn(self):
-        fn = functools.partial(
+        base = functools.partial(
             build_tree_partitioned, num_leaves=self.num_leaves,
             max_depth=self.max_depth, params=self.params,
             num_bins=self.num_bins, use_pallas=self.use_pallas,
@@ -386,14 +386,23 @@ class _ParallelTreeLearner(SerialTreeLearner):
             packed_cols=self.packed_cols, axis_name=self.axis,
             comm_mode=self.comm_mode, num_shards=self.num_shards,
             top_k=int(self.comm.top_k),
-            hist_pool_slots=self.hist_pool_slots)
+            hist_pool_slots=self.hist_pool_slots,
+            hist_precision=self.hist_precision,
+            quant_seed=self.quant_seed)
+
+        # the boosting-iteration scalar rides the shard_map replicated: it
+        # keys the quantized path's stochastic-rounding hash (every shard
+        # hashes GLOBAL row ids against the same iteration)
+        def fn(bins, grad, hess, nd, fm, feat, it):
+            return base(bins, grad, hess, nd, fm, feat, quant_it=it)
+
         row = P() if self.mode == "feature" else P(self.axis)
         bins_spec = P() if self.mode == "feature" else P(self.axis, None)
         out_specs = TreeArrays(
             *([P()] * len(TreeArrays._fields)))._replace(row_leaf=row)
         shard_fn = _shard_map(
             fn, mesh=self.mesh,
-            in_specs=(bins_spec, row, row, P(), P(), P()),
+            in_specs=(bins_spec, row, row, P(), P(), P(), P()),
             out_specs=out_specs)
         return jax.jit(shard_fn)
 
@@ -411,11 +420,12 @@ class _ParallelTreeLearner(SerialTreeLearner):
         return self.pad_rows(grad), self.pad_rows(hess), jnp.asarray(fm)
 
     def train(self, grad: jax.Array, hess: jax.Array, num_data_in_bag,
-              feature_mask=None) -> TreeArrays:
+              feature_mask=None, iteration=0) -> TreeArrays:
         grad, hess, fm = self._prep_train(grad, hess, feature_mask)
         return self._build_fn(self.bins, grad, hess,
                               jnp.asarray(num_data_in_bag, dtype=jnp.int32),
-                              fm, self.feat)
+                              fm, self.feat,
+                              jnp.asarray(iteration, jnp.int32))
 
 
 class DataParallelTreeLearner(_ParallelTreeLearner):
@@ -449,7 +459,7 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
         forced = self.forced
         lazy = self._lazy_active()
 
-        def fn(bins, grad, hess, nd, fm, feat, cegb_args, paid):
+        def fn(bins, grad, hess, nd, fm, feat, cegb_args, paid, it):
             return build_tree_partitioned(
                 bins, grad, hess, nd, fm, feat,
                 num_leaves=self.num_leaves, max_depth=self.max_depth,
@@ -463,7 +473,9 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
                 hist_pool_slots=self.hist_pool_slots,
                 forced=forced,
                 cegb=(cegb_args if cegb_args != () else None),
-                paid_bits=(paid if lazy else None))
+                paid_bits=(paid if lazy else None),
+                hist_precision=self.hist_precision,
+                quant_it=it, quant_seed=self.quant_seed)
 
         row = P(self.axis)
         out_specs = TreeArrays(
@@ -474,11 +486,12 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
         shard_fn = _shard_map(
             fn, mesh=self.mesh,
             in_specs=(P(self.axis, None), row, row, P(), P(), P(), P(),
-                      paid_spec),
+                      paid_spec, P()),
             out_specs=out_specs)
         return jax.jit(shard_fn)
 
-    def train(self, grad, hess, num_data_in_bag, feature_mask=None):
+    def train(self, grad, hess, num_data_in_bag, feature_mask=None,
+              iteration=0):
         grad, hess, fm = self._prep_train(grad, hess, feature_mask)
         cegb_args = (() if self.cegb is None else
                      (self.cegb[0], self.cegb[1], self.cegb_used,
@@ -491,7 +504,8 @@ class PartitionedDataParallelTreeLearner(_ParallelTreeLearner):
         out = self._build_fn(self.bins, grad, hess,
                              jnp.asarray(num_data_in_bag, dtype=jnp.int32),
                              fm, self.feat, cegb_args,
-                             self.cegb_paid if lazy else ())
+                             self.cegb_paid if lazy else (),
+                             jnp.asarray(iteration, jnp.int32))
         if lazy:
             arrays, self.cegb_paid = out
         else:

@@ -80,6 +80,15 @@ def candidate_plans(sc: planner.ShapeClass) -> Tuple[Candidate, ...]:
         sched("wide-mid", ((True, SMALL_CHUNK, small_max),
                            (False, SMALL_CHUNK, 2 * _MID_MAX),
                            (False, CHUNK, None)))
+    # round 22: quantized-gradient histograms halve the factored
+    # accumulator per group, so the same VMEM gate admits doubled groups
+    # and a wider mid/level window — raced as candidates, never assumed
+    if getattr(sc, "quantized", False):
+        add("quant-2xgroups", hist_groups=int(base.hist_groups) * 2)
+        if 4 * _MID_MAX < n:
+            sched("quant-wide-level", ((True, SMALL_CHUNK, small_max),
+                                       (False, SMALL_CHUNK, 4 * _MID_MAX),
+                                       (False, CHUNK, None)))
     # predict tree-block VMEM budget: half and double the 1 MiB default
     pb = int(base.predict_block_vmem_bytes)
     add("predict-halfvmem", predict_block_vmem_bytes=pb // 2)

@@ -48,6 +48,11 @@ class ShapeClass(NamedTuple):
     packed: bool           # 4-bit nibble packing
     num_class: int
     device_kind: str
+    # round 22: quantized-gradient histograms run a 2-row integer operand —
+    # half the factored accumulator per group, so the same VMEM gate admits
+    # twice the groups / wider level windows.  A distinct planning axis:
+    # exact and quantized builds must never share a tuned entry.
+    quantized: bool = False
 
 
 class Plan(NamedTuple):
@@ -72,13 +77,14 @@ class Plan(NamedTuple):
 
 def shape_class(n_rows: int, num_features: int, num_bins: int, *,
                 bpc: int = 1, packed: bool = False, num_class: int = 1,
-                device_kind: Optional[str] = None) -> ShapeClass:
+                device_kind: Optional[str] = None,
+                quantized: bool = False) -> ShapeClass:
     """Normalize raw shape facts into the planning key."""
     if device_kind is None:
         device_kind = device_specs.current_device_kind()
     return ShapeClass(int(n_rows), int(num_features), int(num_bins),
                       int(bpc), bool(packed), int(num_class),
-                      str(device_kind).lower())
+                      str(device_kind).lower(), bool(quantized))
 
 
 def _pow2_ceil(n: int) -> int:
@@ -91,9 +97,14 @@ def _pow2_ceil(n: int) -> int:
 def plan_key(sc: ShapeClass) -> str:
     """Cache key of a shape class: rows bucketized to their power-of-two
     class (one tuned entry per size regime, not per exact n)."""
-    return "n%d|f%d|b%d|bpc%d|pk%d|k%d|%s" % (
+    key = "n%d|f%d|b%d|bpc%d|pk%d|k%d|%s" % (
         _pow2_ceil(max(sc.n_rows, 1)), sc.num_features, sc.num_bins,
         sc.bpc, int(sc.packed), sc.num_class, sc.device_kind or "unknown")
+    if getattr(sc, "quantized", False):
+        # suffix only on the new axis: every pre-round-22 cache entry keeps
+        # its key (and keeps applying to exact builds only)
+        key += "|q1"
+    return key
 
 
 def analytic_plan(sc: ShapeClass) -> Plan:
@@ -104,11 +115,14 @@ def analytic_plan(sc: ShapeClass) -> Plan:
     from ..core.histogram import _factored_geometry, _use_factored
     from ..core.partition import fused_bucket_plan, level_plan
     from ..core.predict_fused import PREDICT_BUCKETS
-    _, groups = _factored_geometry(sc.num_features, sc.num_bins)
+    quant = bool(getattr(sc, "quantized", False))
+    _, groups = _factored_geometry(sc.num_features, sc.num_bins,
+                                   quantized=quant)
     return Plan(
         bucket_plan=fused_bucket_plan(sc.n_rows),
         level_ladder=level_plan(sc.n_rows),
-        hist_factored=_use_factored(sc.num_features, sc.num_bins),
+        hist_factored=_use_factored(sc.num_features, sc.num_bins,
+                                    quantized=quant),
         hist_groups=int(groups),
         hist_accum_budget_bytes=device_specs.hist_accum_budget_bytes(
             sc.device_kind),

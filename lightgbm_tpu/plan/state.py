@@ -130,12 +130,13 @@ def pinned(plan: planner.Plan):
 
 def resolve(n_rows: int, num_features: int, num_bins: int, *,
             bpc: int = 1, packed: bool = False, num_class: int = 1,
-            device_kind: Optional[str] = None) -> planner.Plan:
+            device_kind: Optional[str] = None,
+            quantized: bool = False) -> planner.Plan:
     """The planner entry point: pinned > tuned (engaged cache, validated)
     > analytic.  Never raises, never returns None."""
     sc = planner.shape_class(n_rows, num_features, num_bins, bpc=bpc,
                              packed=packed, num_class=num_class,
-                             device_kind=device_kind)
+                             device_kind=device_kind, quantized=quantized)
     with _lock:
         pinned_plan = _state["pinned"]
         cache = _state["cache"]

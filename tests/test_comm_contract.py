@@ -35,7 +35,8 @@ def _lowered_text(n, d, num_leaves=8):
     hess = learner.pad_rows(jnp.ones((n,), dtype=jnp.float32))
     fm = jnp.ones((learner.feat.num_bin.shape[0],), bool)
     lowered = learner._build_fn.lower(
-        learner.bins, grad, hess, jnp.int32(n), fm, learner.feat)
+        learner.bins, grad, hess, jnp.int32(n), fm, learner.feat,
+        jnp.int32(0))
     return lowered.as_text(), learner
 
 
@@ -98,7 +99,8 @@ def test_voting_elected_psum_payload():
     hess = learner.pad_rows(jnp.ones((n,), dtype=jnp.float32))
     fm = jnp.ones((learner.feat.num_bin.shape[0],), bool)
     txt = learner._build_fn.lower(
-        learner.bins, grad, hess, jnp.int32(n), fm, learner.feat).as_text()
+        learner.bins, grad, hess, jnp.int32(n), fm, learner.feat,
+        jnp.int32(0)).as_text()
     # collect each all_reduce op's RESULT type (ops span multiple lines)
     lines = txt.splitlines()
     ar_types = []
@@ -157,7 +159,8 @@ def test_feature_parallel_histogram_state_is_sharded():
     hess = learner.pad_rows(jnp.ones((n,), dtype=jnp.float32))
     fm = jnp.ones((learner.feat.num_bin.shape[0],), bool)
     txt = learner._build_fn.lower(
-        learner.bins, grad, hess, jnp.int32(n), fm, learner.feat).as_text()
+        learner.bins, grad, hess, jnp.int32(n), fm, learner.feat,
+        jnp.int32(0)).as_text()
     per_shard = F // d
     assert re.search(rf"tensor<{L}x{per_shard}x2x{B_KERNEL}xf32>", txt), \
         "per-shard histogram state [L, F/d, 2, B] not found"

@@ -407,6 +407,12 @@ def test_telemetry_off_hot_loop_makes_zero_calls(monkeypatch, tmp_path):
     assert obs.active() is None
     booster, X, _ = _toy_booster(num_iterations=8)
     booster.train_chunk(8)
+    # round 22: the quantized-gradient training path's chunk telemetry
+    # (quant counters/gauges + kind="quant" events) is behind the same
+    # tele-is-None gate and must stay silent too
+    qb, _, _ = _toy_booster(n=512, num_iterations=2,
+                            hist_precision="quantized")
+    qb.train_chunk(2)
     booster.predict(X[:600])
     booster.predict_binned()  # the binned quality-hook path, off
     booster.predict_contrib(X[:64])  # the contrib plane (round 19), off
@@ -556,6 +562,61 @@ def test_timer_reset_discards_other_threads_inflight_scopes():
     go.set()
     th.join()
     assert t.total("x") == 0.0, "pre-reset scope leaked into fresh totals"
+
+
+# ---- round 22: quantized-training telemetry + died-run recovery ----
+
+def test_quant_telemetry_counters_and_recovery(tmp_path):
+    """A quantized run records the quant counters/gauges and kind="quant"
+    events, the summary carries the quant block, and tools/obs_report.py
+    rebuilds the same block from the raw events alone (died-run path).
+    An exact run emits none of it."""
+    import os
+    import sys
+    out = str(tmp_path / "q.jsonl")
+    tele = obs.configure(out=out, freq=1)
+    booster, _, _ = _toy_booster(n=512, num_iterations=4,
+                                 hist_precision="quantized")
+    booster.train_chunk(4)
+    assert tele.counter("quant_chunks").value == 1
+    assert tele.counter("quant_iters").value == 4
+    assert tele.gauge("quant_grad_levels").value == 127
+    assert tele.gauge("quant_hess_levels").value == 255
+    assert tele.gauge("quant_hist_channels").value == 2
+    from lightgbm_tpu.obs.report import finalize_run, human_table
+    summary = finalize_run(tele, gbdt=booster, wall_s=1.0, iters=4)
+    tele.flush()
+    obs.disable()
+    q = summary["quant"]
+    assert q["chunks"] == 1 and q["iterations"] == 4
+    assert q["grad_levels"] == 127 and q["hess_levels"] == 255
+    assert q["hist_channels"] == 2
+    assert "quant:" in human_table(summary)
+    # died-run recovery: raw events alone rebuild the block (the event
+    # stream has no summary to lean on)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    from lightgbm_tpu.obs.registry import read_events
+    events = read_events(out)
+    assert any(e["kind"] == "quant" and e["hist_channels"] == 2
+               and e["exact_channels"] == 4 for e in events)
+    rebuilt = obs_report.summary_from_events(events)
+    rq = rebuilt["quant"]
+    assert rq["recovered"] is True
+    assert rq["chunks"] == 1 and rq["iterations"] == 4
+    assert rq["grad_levels"] == 127 and rq["hist_channels"] == 2
+    assert "quant:" in human_table(rebuilt)
+    # an exact run's summary has no quant block
+    tele2 = obs.configure(freq=1)
+    b2, _, _ = _toy_booster(n=512, num_iterations=2)
+    b2.train_chunk(2)
+    from lightgbm_tpu.obs.report import summarize
+    assert "quant" not in summarize(tele2)
+    assert tele2.counter("quant_chunks").value == 0
 
 
 # ---- nan_policy trips reach the telemetry counters ----

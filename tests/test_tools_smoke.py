@@ -62,6 +62,35 @@ def test_every_tool_answers_help():
         assert "ok: %s" % name in p.stdout, (name, p.stdout, p.stderr)
 
 
+def test_gen_params_check_in_sync():
+    """``gen_params.py --check`` is the staleness tripwire for the
+    embedded ``_params_meta.py`` tail: it must pass on the committed
+    tree, and fail loudly when the meta file drifts from the generator
+    (a hand-edited tail is exactly the rot it exists to catch)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    tool = os.path.join(REPO, "tools", "gen_params.py")
+    p = subprocess.run([sys.executable, tool, "--check"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env, cwd=REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+    # a drifted meta file must flunk the check, naming the problem
+    import tempfile
+    with open(os.path.join(REPO, "lightgbm_tpu", "_params_meta.py")) as fh:
+        meta = fh.read()
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as tmp:
+        tmp.write(meta.replace("'hist_precision'", "'hist_drifted'", 1))
+        stale = tmp.name
+    try:
+        p = subprocess.run([sys.executable, tool, "--check",
+                            "--meta", stale],
+                           capture_output=True, text=True, timeout=120,
+                           env=env, cwd=REPO)
+        assert p.returncode != 0, p.stdout + p.stderr
+    finally:
+        os.unlink(stale)
+
+
 def test_bench_split_cost_importable():
     """The round-7 acceptance tool parses args and exposes its sweep/fit
     entry points without touching jax at import time."""

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Quantized-gradient training A/B: the round-22 acceptance instrument.
+
+``hist_precision=quantized`` stochastically rounds per-iteration
+gradients/hessians to low-bit integers (127/255 levels) so the one-hot
+histogram operand drops from 4 bf16 value rows (hi/lo split) to 2 —
+half the MXU rows in the hottest op, half the factored accumulator
+VMEM, and a bf16 (half-width) histogram allreduce on pods.  This bench
+measures what that buys and what it costs, in the BENCH artifact shape
+the perf gate consumes:
+
+- ``operand``      — bytes per (row, feature) of the histogram value
+                     operand, exact vs quantized, and their ratio (0.5
+                     by construction: nch 4 -> 2 at equal bf16 width);
+- ``accumulator``  — the factored-path f32 accumulator footprint from
+                     the plan geometry (``_factored_out_shape``), exact
+                     vs quantized, plus the hist_groups counts (the
+                     halved accumulator packs twice the features per
+                     MXU group);
+- ``quant``        — the lossy-path error: full-train max |score delta|
+                     and AUC delta vs the exact twin, the determinism
+                     re-run (same seed twice -> byte-identical scores)
+                     and the XLA-fallback vs fused-Pallas-interpret
+                     parity (quantized sums are small integers in f32,
+                     so backends must agree BIT-exactly);
+- ``budgets``      — the PERF_BUDGETS.json lines this artifact is gated
+                     against, echoed so the artifact is self-describing.
+
+On this CPU box the walls are interpret-proxies; the PERF.md round-22
+protocol reruns this unchanged on TPU hardware.
+
+Usage::
+
+    python tools/bench_hist_quant.py --out BENCH_hist_quant_interp.json
+        [--rows 4096] [--cols 20] [--iters 20] [--quick]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _auc(y, scores):
+    """Tie-averaged rank AUC (no sklearn in the image)."""
+    import numpy as np
+    y = np.asarray(y).astype(bool)
+    s = np.asarray(scores, np.float64)
+    order = np.argsort(s, kind="mergesort")
+    s, y = s[order], y[order]
+    _, idx, cnt = np.unique(s, return_index=True, return_counts=True)
+    ranks = np.repeat(idx + (cnt + 1) / 2.0, cnt)  # 1-based, tie-averaged
+    npos = int(y.sum())
+    nneg = len(y) - npos
+    if not npos or not nneg:
+        return float("nan")
+    return float((ranks[y].sum() - npos * (npos + 1) / 2.0)
+                 / (npos * nneg))
+
+
+def _make_data(rows, cols, seed=11):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(rows, cols))
+    logit = x[:, 0] * 1.4 - 0.8 * x[:, 1] + np.sin(x[:, 2] * 2.0) \
+        + 0.3 * x[:, 3] * x[:, 4]
+    y = (logit + rng.logistic(scale=0.5, size=rows) > 0).astype(np.float64)
+    return x, y
+
+
+def _train(x, y, iters, hist_precision, pallas=False, **extra):
+    """One full training run; returns (scores, booster, chunk walls)."""
+    import numpy as np
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.objective import create_objective
+    cfg = Config(dict(objective="binary", num_leaves=31,
+                      min_data_in_leaf=5, learning_rate=0.1,
+                      num_iterations=iters, seed=7,
+                      hist_precision=hist_precision, **extra))
+    ds = BinnedDataset.from_matrix(x, label=y, max_bin=256)
+    b = GBDT(cfg, ds, create_objective("binary", cfg))
+    if pallas:
+        b.learner.use_pallas = True
+        b.learner.pallas_interpret = True
+    walls = []
+    half = max(iters // 2, 1)
+    for k in (half, iters - half):
+        if k <= 0:
+            continue
+        t0 = time.perf_counter()
+        b.train_chunk(k)
+        walls.append(time.perf_counter() - t0)
+    return np.asarray(b.train_score, np.float32).ravel(), b, walls
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="exact vs quantized-gradient training A/B: operand "
+                    "bytes/row, accumulator VMEM from the plan geometry, "
+                    "full-train score/AUC deltas, determinism and "
+                    "backend bit-parity")
+    ap.add_argument("--rows", type=int, default=4096,
+                    help="training rows (CHUNK-aligned so the Pallas "
+                         "parity leg engages the fused path off-TPU)")
+    ap.add_argument("--cols", type=int, default=20)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--bins", type=int, default=256)
+    ap.add_argument("--out", default=None, help="artifact path (JSON)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small grid for smoke runs")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.quick:
+        args.rows, args.iters = 4096, 4
+    import numpy as np
+    from lightgbm_tpu.core.histogram import (_factored_geometry,
+                                             _factored_out_shape,
+                                             _hilo_factors, _hist_channels)
+    x, y = _make_data(args.rows, args.cols)
+
+    t0 = time.perf_counter()
+    s_exact, b_exact, w_exact = _train(x, y, args.iters, "exact")
+    s_quant, _, w_quant = _train(x, y, args.iters, "quantized")
+    s_quant2, _, _ = _train(x, y, args.iters, "quantized")
+    deterministic = bool(np.array_equal(s_quant, s_quant2))
+    max_delta = float(np.max(np.abs(s_exact - s_quant)))
+    auc_e, auc_q = _auc(y, s_exact), _auc(y, s_quant)
+    print("trained 3x %d iters in %.1fs: max|score delta| %.4g, "
+          "AUC %.5f (exact) vs %.5f (quantized), deterministic=%s"
+          % (args.iters, time.perf_counter() - t0, max_delta,
+             auc_e, auc_q, deterministic))
+
+    # backend parity: the quantized histogram sums are small integers held
+    # in f32, so the XLA segment-sum fallback and the fused Pallas kernels
+    # (interpret off-TPU) must agree BIT-exactly, not approximately
+    k_par = min(args.iters, 2)
+    s_fb, _, _ = _train(x, y, k_par, "quantized")
+    s_pl, _, _ = _train(x, y, k_par, "quantized", pallas=True)
+    backend_bit_exact = bool(np.array_equal(s_fb, s_pl))
+    print("backend parity over %d iters: XLA fallback vs Pallas "
+          "interpret bit-exact=%s" % (k_par, backend_bit_exact))
+
+    # static geometry from the plan seam, not re-derived constants
+    F, B = args.cols, args.bins
+    nhi, nlo = _hilo_factors(B)
+    nch_e, nch_q = _hist_channels(False), _hist_channels(True)
+    shp_e = _factored_out_shape(F, B, False)
+    shp_q = _factored_out_shape(F, B, True)
+    _, grp_e = _factored_geometry(F, B, False)
+    _, grp_q = _factored_geometry(F, B, True)
+    operand = {
+        "channels_exact": nch_e, "channels_quantized": nch_q,
+        # bf16 value rows per (row, feature) of the one-hot hi operand
+        "bytes_per_row_feature_exact": nch_e * nhi * 2,
+        "bytes_per_row_feature_quantized": nch_q * nhi * 2,
+        "bytes_ratio": nch_q / nch_e,
+    }
+    accumulator = {
+        # the freed channel rows pack 2x the features per 128-row group,
+        # so the TOTAL f32 accumulator for a fixed F is layout-invariant;
+        # the win lands as half the groups (half the MXU passes and the
+        # autotuner's quant-2xgroups headroom under the same VMEM gate)
+        "vmem_bytes_exact": shp_e[0] * shp_e[1] * 4,
+        "vmem_bytes_quantized": shp_q[0] * shp_q[1] * 4,
+        "hist_groups_exact": grp_e, "hist_groups_quantized": grp_q,
+        "groups_ratio": grp_q / float(grp_e),
+    }
+
+    budgets_path = os.path.join(REPO, "PERF_BUDGETS.json")
+    declared = {}
+    try:
+        with open(budgets_path) as fh:
+            all_b = json.load(fh).get("budgets") or {}
+        declared = {k: v for k, v in sorted(all_b.items())
+                    if k.startswith("quant_")}
+    except (OSError, ValueError):
+        pass
+
+    doc = {
+        "metric": "hist_quant",
+        "unit": "max_abs_score_delta",
+        "value": round(max_delta, 6),
+        "mode": "interpret",
+        "rows": args.rows, "cols": args.cols, "bins": B,
+        "iterations": args.iters,
+        "operand": operand,
+        "accumulator": accumulator,
+        "quant": {
+            "grad_levels": 127, "hess_levels": 255,
+            "max_score_delta": round(max_delta, 6),
+            "auc_exact": round(auc_e, 6),
+            "auc_quantized": round(auc_q, 6),
+            "auc_delta": round(abs(auc_e - auc_q), 6),
+            "deterministic": deterministic,
+            "backend_bit_exact": backend_bit_exact,
+            # CPU walls are proxies: the MXU-row halving only pays on TPU
+            "warm_chunk_s_exact": round(min(w_exact), 6),
+            "warm_chunk_s_quantized": round(min(w_quant), 6),
+        },
+        "budgets": declared,
+    }
+    out = json.dumps(doc, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+        print("wrote %s" % args.out)
+    else:
+        print(out)
+    for k, v in declared.items():
+        print("budget %s=%s" % (k, v))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

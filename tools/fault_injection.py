@@ -146,7 +146,8 @@ def build(n_iter, snapshot_freq, nan_policy="raise"):
     cfg = Config(objective="regression", num_leaves=15, min_data_in_leaf=5,
                  bagging_fraction=0.8, bagging_freq=3, verbosity=-1,
                  num_iterations=n_iter, snapshot_freq=snapshot_freq,
-                 metric_freq=4, nan_policy=nan_policy)
+                 metric_freq=4, nan_policy=nan_policy,
+                 hist_precision=os.environ.get("HIST_PRECISION", "exact"))
     ds = BinnedDataset.from_matrix(X, label=y, max_bin=cfg.max_bin,
                                    min_data_in_leaf=cfg.min_data_in_leaf)
     booster = create_boosting(cfg.boosting, cfg,
@@ -353,6 +354,53 @@ def scenario_sigterm(workdir: str) -> None:
         "SIGTERM-preempted resume diverged from the uninterrupted run"
     print("PASS sigterm: exit code %d + emergency checkpoint at iter %d; "
           "resume is bit-exact" % (EXIT_PREEMPTED, resumed))
+
+
+def scenario_quant_preempt(workdir: str) -> None:
+    """SIGTERM mid-run under quantized-gradient training (round 22):
+    exit 75 + emergency checkpoint, and the resumed model is
+    byte-identical to the uninterrupted quantized run — the stochastic
+    rounding is a stateless hash of (iteration, global row), so replayed
+    chunk iterations re-quantize identically, like the bagging mask."""
+    from lightgbm_tpu.checkpoint import list_checkpoints
+    from lightgbm_tpu.resilience import EXIT_PREEMPTED
+    total, sf = 20, 7
+    qenv = {"HIST_PRECISION": "quantized"}
+    ref_out = os.path.join(workdir, "ref_model_q.txt")
+    p = _run_child(_KILL_CHILD_SRC, dict(qenv, **{
+        "MODEL_OUT": ref_out, "TOTAL_ITERS": str(total),
+        "SNAP_FREQ": str(sf), "KILL_AT_WRITE_N": "0"}))
+    assert "TRAINED-TO-END" in p.stdout, p.stdout + p.stderr
+    with open(ref_out) as fh:
+        ref = fh.read()
+    out = os.path.join(workdir, "model_qsig.txt")
+    p = _run_child(_SIGTERM_CHILD_SRC, dict(qenv, **{
+        "MODEL_OUT": out, "TOTAL_ITERS": str(total), "SNAP_FREQ": str(sf),
+        "SIG_AFTER_CHUNKS": "2"}))
+    assert p.returncode == EXIT_PREEMPTED, \
+        "expected exit %d (resumable), got %r: %s" % (
+            EXIT_PREEMPTED, p.returncode, p.stdout + p.stderr[-2000:])
+    assert "PREEMPTED" in p.stdout and "TRAINED-TO-END" not in p.stdout
+    assert list_checkpoints(out), "no emergency checkpoint on disk"
+    sys.path.insert(0, REPO)
+    ns = {}
+    prev = os.environ.get("HIST_PRECISION")
+    os.environ["HIST_PRECISION"] = "quantized"
+    try:
+        exec(compile(_TRAIN_SRC, "<train>", "exec"), ns)
+        booster = ns["build"](total, sf)
+        resumed = booster.resume_from_checkpoint(out)
+        assert 0 < resumed < total, resumed
+        booster.train()
+    finally:
+        if prev is None:
+            os.environ.pop("HIST_PRECISION", None)
+        else:
+            os.environ["HIST_PRECISION"] = prev
+    assert booster.save_model_to_string() == ref, \
+        "quantized preempted resume diverged from the uninterrupted run"
+    print("PASS quant-preempt: exit %d + checkpoint at iter %d; quantized "
+          "resume is byte-identical" % (EXIT_PREEMPTED, resumed))
 
 
 # ---- scrape-under-preempt: live exporter through the SIGTERM drill ----
@@ -1670,6 +1718,7 @@ SCENARIOS = {"kill-write": scenario_kill_write,
              "corrupt": scenario_corrupt,
              "nan-grad": scenario_nan_grad,
              "sigterm": scenario_sigterm,
+             "quant-preempt": scenario_quant_preempt,
              "hang": scenario_hang,
              "enospc": scenario_enospc}
 

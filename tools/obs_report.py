@@ -192,6 +192,12 @@ def summary_from_events(events):
     ing_counters = {}
     ing_gauges = {}
     ing_hists = {}
+    # quantized-training recovery (round 22): kind="quant" chunk events
+    # rebuild the quant block — how many chunks/iterations rode the
+    # integer-histogram path and its static geometry — for runs that died
+    # before the summary writer ran
+    qnt_counters = {}
+    qnt_gauges = {}
     n_events = 0
     for e in events:
         n_events += 1
@@ -302,6 +308,16 @@ def summary_from_events(events):
                 ing_gauges["host_rss_high_water_bytes"] = max(
                     int(ing_gauges.get("host_rss_high_water_bytes", 0)),
                     int(e["rss_high_water"]))
+        if e["kind"] == "quant":
+            qnt_counters["quant_chunks"] = \
+                qnt_counters.get("quant_chunks", 0) + 1
+            qnt_counters["quant_iters"] = \
+                qnt_counters.get("quant_iters", 0) + int(e.get("iters", 0))
+            for field, gname in (("grad_levels", "quant_grad_levels"),
+                                 ("hess_levels", "quant_hess_levels"),
+                                 ("hist_channels", "quant_hist_channels")):
+                if e.get(field) is not None:
+                    qnt_gauges[gname] = e[field]
         if e["kind"] == "serve_batch" and e.get("contrib"):
             ctb_counters["serve_contrib_requests"] = \
                 ctb_counters.get("serve_contrib_requests", 0) \
@@ -412,6 +428,10 @@ def summary_from_events(events):
                           {k: h.summary() for k, h in ing_hists.items()})
     if ingest is not None:
         ingest["recovered"] = True
+    from lightgbm_tpu.obs.report import quant_block
+    quant = quant_block(qnt_counters, qnt_gauges, {})
+    if quant is not None:
+        quant["recovered"] = True
     compile_block = None
     if compile_keys:
         compile_block = {
@@ -450,6 +470,7 @@ def summary_from_events(events):
         **({"online": online} if online else {}),
         **({"contrib": contrib} if contrib else {}),
         **({"ingest": ingest} if ingest else {}),
+        **({"quant": quant} if quant else {}),
         **({"compile": compile_block} if compile_block else {}),
         **({"alerts": alerts_block} if alerts_block else {}),
         **({"plan": plan_block} if plan_block else {}),

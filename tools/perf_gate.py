@@ -37,9 +37,10 @@ budgets:
   the worst contrib p99 within ``contrib_p99_factor`` of the same
   artifact's score headline.
 
-Artifact type is sniffed from its keys (telemetry summary / bench-serve
-grid / split-cost / bench.py wrapper), so one invocation can gate a mixed
-pile.  Exit status: 0 all pass, 1 any breach, 2 unreadable input.
+Artifact types live in one declarative REGISTRY table (predicate +
+gate function per type), so one invocation can gate a mixed pile; an
+artifact matching no registry row fails loudly naming the file.  Exit
+status: 0 all pass, 1 any breach, 2 unreadable/unidentifiable input.
 """
 import argparse
 import json
@@ -85,26 +86,13 @@ def _baseline(budgets_path: str, budgets: dict, key: str):
     return _load(path), path
 
 
-def sniff(doc: dict) -> str:
-    """Artifact type from its keys."""
+def sniff(doc) -> str:
+    """Artifact type from the registry (first matching row)."""
     if not isinstance(doc, dict):
         return "unknown"
-    if "parsed" in doc and isinstance(doc["parsed"], dict):
-        return "bench_wrapper"
-    if doc.get("metric") == "telemetry_run":
-        return "summary"
-    if doc.get("metric") == "plan_autotune":
-        return "autotune"
-    if doc.get("metric") == "precision_tiers":
-        return "precision"
-    if doc.get("metric") == "ingest_stream":
-        return "ingest"
-    if "grid" in doc and "dropped" in doc:
-        return "serve"
-    if "level" in doc or ("points" in doc and "fits" in doc):
-        return "split_cost"
-    if "metric" in doc and "value" in doc:
-        return "bench_line"
+    for kind, match, _gate in REGISTRY:
+        if match(doc):
+            return kind
     return "unknown"
 
 
@@ -352,6 +340,44 @@ def gate_ingest(g: Gate, path: str, doc: dict, b: dict) -> None:
                "no ingest_rows_per_s_factor_min budget or factor in artifact")
 
 
+def gate_hist_quant(g: Gate, path: str, doc: dict, b: dict) -> None:
+    """BENCH_hist_quant artifacts (round 22, tools/bench_hist_quant.py):
+    quantized-gradient training is LOSSY, so an artifact with no declared
+    budget line FAILS outright (the round-20 rule: the error budget is a
+    gate, not a footnote).  Within budgets, the score/AUC deltas must
+    hold, the operand halving must be real, and the correctness half of
+    the contract — seed-determinism and XLA-vs-Pallas bit-parity — must
+    be true, not approximately true."""
+    q = doc.get("quant") or {}
+    for bkey, field, label in (
+            ("quant_max_score_delta", "max_score_delta", "score delta"),
+            ("quant_auc_delta_max", "auc_delta", "auc delta")):
+        bar = b.get(bkey)
+        if bar is None:
+            g.check(path, "budget declared [%s]" % bkey, False,
+                    "lossy quantized artifact has no %s line in the "
+                    "budgets — every lossy path must carry a declared "
+                    "budget" % bkey)
+            continue
+        val = q.get(field)
+        g.check(path, "%s within budget [quant]" % label,
+                val is not None and float(val) <= float(bar),
+                "%s %s <= %s" % (field, val, bar))
+    ratio = (doc.get("operand") or {}).get("bytes_ratio")
+    rmax = b.get("quant_bytes_ratio_max")
+    if ratio is not None and rmax is not None:
+        g.check(path, "operand bytes/row halved",
+                float(ratio) <= float(rmax),
+                "%.3f <= %.3f (2-row vs 4-row bf16 operand)"
+                % (float(ratio), float(rmax)))
+    g.check(path, "quantized training deterministic",
+            q.get("deterministic") is True,
+            "same seed twice -> byte-identical scores")
+    g.check(path, "backend bit-parity",
+            q.get("backend_bit_exact") is True,
+            "XLA fallback == fused Pallas interpret, bit-exact")
+
+
 def gate_bench_line(g: Gate, path: str, doc: dict, b: dict) -> None:
     if "recompiles_steady" in doc:
         g.check(path, "recompiles steady",
@@ -458,6 +484,47 @@ def gate_summary(g: Gate, path: str, doc: dict, b: dict,
                "no telemetry baseline with a compile section")
 
 
+# ---- the artifact-type registry ----------------------------------------
+#
+# One declarative row per artifact type the gate understands:
+# (kind, match predicate, gate callable taking (g, path, doc, budgets,
+# ctx)) where ctx holds the shared baseline artifacts.  sniff() and
+# run_gate() both walk THIS table — adding an artifact type is one row
+# plus its gate function, never a second if-chain — and an artifact
+# matching no row fails loudly naming the file.  Order matters: the
+# metric-tagged types come before the loose key-shape fallbacks.
+
+def _metric(name):
+    return lambda doc: doc.get("metric") == name
+
+
+REGISTRY = (
+    ("bench_wrapper", lambda d: isinstance(d.get("parsed"), dict),
+     None),  # unwrapped in run_gate, then re-sniffed
+    ("summary", _metric("telemetry_run"),
+     lambda g, p, d, b, ctx: gate_summary(
+         g, p, d, b, ctx["telemetry"],
+         forensics_baseline=ctx["forensics"])),
+    ("autotune", _metric("plan_autotune"),
+     lambda g, p, d, b, ctx: gate_autotune(g, p, d, b)),
+    ("precision", _metric("precision_tiers"),
+     lambda g, p, d, b, ctx: gate_precision(g, p, d, b)),
+    ("hist_quant", _metric("hist_quant"),
+     lambda g, p, d, b, ctx: gate_hist_quant(g, p, d, b)),
+    ("ingest", _metric("ingest_stream"),
+     lambda g, p, d, b, ctx: gate_ingest(g, p, d, b)),
+    ("serve", lambda d: "grid" in d and "dropped" in d,
+     lambda g, p, d, b, ctx: gate_serve(g, p, d, b, ctx["serve"])),
+    ("split_cost",
+     lambda d: "level" in d or ("points" in d and "fits" in d),
+     lambda g, p, d, b, ctx: gate_split_cost(g, p, d, b)),
+    ("bench_line", lambda d: "metric" in d and "value" in d,
+     lambda g, p, d, b, ctx: gate_bench_line(g, p, d, b)),
+)
+
+_GATERS = {kind: gate for kind, _m, gate in REGISTRY}
+
+
 def run_gate(artifacts, budgets_path: str) -> int:
     try:
         spec = _load(budgets_path)
@@ -466,9 +533,9 @@ def run_gate(artifacts, budgets_path: str) -> int:
               file=sys.stderr)
         return 2
     b = spec.get("budgets") or {}
-    serve_baseline, _ = _baseline(budgets_path, spec, "serve")
-    tele_baseline, _ = _baseline(budgets_path, spec, "telemetry")
-    forensics_baseline, _ = _baseline(budgets_path, spec, "forensics")
+    ctx = {"serve": _baseline(budgets_path, spec, "serve")[0],
+           "telemetry": _baseline(budgets_path, spec, "telemetry")[0],
+           "forensics": _baseline(budgets_path, spec, "forensics")[0]}
     if not artifacts:
         # default: gate the committed baseline artifacts themselves (the
         # self-consistency run CI uses)
@@ -494,26 +561,17 @@ def run_gate(artifacts, budgets_path: str) -> int:
         kind = sniff(doc)
         if kind == "bench_wrapper":
             doc, kind = doc["parsed"], sniff(doc["parsed"])
-        if kind == "serve":
-            gate_serve(g, path, doc, b, serve_baseline)
-        elif kind == "split_cost":
-            gate_split_cost(g, path, doc, b)
-        elif kind == "summary":
-            gate_summary(g, path, doc, b, tele_baseline,
-                         forensics_baseline=forensics_baseline)
-        elif kind == "autotune":
-            gate_autotune(g, path, doc, b)
-        elif kind == "precision":
-            gate_precision(g, path, doc, b)
-        elif kind == "ingest":
-            gate_ingest(g, path, doc, b)
-        elif kind == "bench_line":
-            gate_bench_line(g, path, doc, b)
-        else:
-            print("cannot identify artifact %s (keys: %s)"
+        gater = _GATERS.get(kind)
+        if gater is None:
+            print("cannot identify artifact %s: no registry row matches "
+                  "(keys: %s; known types: %s)"
                   % (path, sorted(doc)[:8] if isinstance(doc, dict)
-                     else type(doc).__name__), file=sys.stderr)
+                     else type(doc).__name__,
+                     ", ".join(k for k, _m, gt in REGISTRY if gt)),
+                  file=sys.stderr)
             rc = 2
+            continue
+        gater(g, path, doc, b, ctx)
     print("perf gate: %d checks, %d failed" % (g.checks, g.failures))
     if g.failures:
         return 1
